@@ -1,0 +1,48 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh so all sharding /
+collective logic is exercised without TPU hardware (SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from tpu_rl.config import Config  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, devs
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def small_config(**kw) -> Config:
+    base = dict(
+        hidden_size=16,
+        seq_len=5,
+        batch_size=8,
+        buffer_size=32,
+        obs_shape=(4,),
+        action_space=2,
+        time_horizon=32,
+    )
+    base.update(kw)
+    return Config.from_dict(base)
+
+
+@pytest.fixture
+def cfg():
+    return small_config()

@@ -1,0 +1,89 @@
+"""GAE / V-trace scans vs. independent numpy reverse-loop oracles
+(formulas per SURVEY.md §2.3 'Loss primitives';
+reference /root/reference/agents/learner_module/compute_loss.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from tpu_rl.ops.returns import gae, vtrace
+
+
+def np_gae(deltas, gamma, lmbda):
+    B, T = deltas.shape[:2]
+    out = np.zeros_like(deltas)
+    acc = np.zeros_like(deltas[:, 0])
+    for t in reversed(range(T)):
+        acc = deltas[:, t] + gamma * lmbda * acc
+        out[:, t] = acc
+    return out
+
+
+def np_vtrace(behav_lp, target_lp, is_fir, rew, val, gamma, rho_bar, rho_min, c_bar):
+    ratio = np.exp(target_lp[:, :-1] - behav_lp[:, :-1])
+    rho = np.clip(ratio, rho_min, rho_bar)
+    c = np.minimum(ratio, c_bar)
+    disc = gamma * (1.0 - is_fir[:, 1:])
+    td = rew[:, :-1] + disc * val[:, 1:]
+    deltas = rho * (td - val[:, :-1])
+    T = deltas.shape[1]
+    dv = np.zeros_like(val)
+    for t in reversed(range(T)):
+        dv[:, t] = deltas[:, t] + c[:, t] * disc[:, t] * dv[:, t + 1]
+    vs = val + dv
+    adv = rho * (rew[:, :-1] + disc * vs[:, 1:] - val[:, :-1])
+    return rho, adv, vs
+
+
+def test_gae_matches_loop(rng):
+    deltas = rng.normal(size=(4, 7, 1)).astype(np.float32)
+    got = np.asarray(gae(jnp.asarray(deltas), 0.99, 0.95))
+    want = np_gae(deltas, 0.99, 0.95)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gae_no_discount_is_suffix_sum(rng):
+    deltas = rng.normal(size=(2, 5, 1)).astype(np.float32)
+    got = np.asarray(gae(jnp.asarray(deltas), 1.0, 1.0))
+    want = np.flip(np.cumsum(np.flip(deltas, 1), axis=1), 1)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_vtrace_matches_loop(rng):
+    B, S = 6, 5
+    behav = rng.normal(size=(B, S, 1)).astype(np.float32) - 1.0
+    target = behav + rng.normal(size=(B, S, 1)).astype(np.float32) * 0.3
+    fir = (rng.random((B, S, 1)) < 0.2).astype(np.float32)
+    rew = rng.normal(size=(B, S, 1)).astype(np.float32)
+    val = rng.normal(size=(B, S, 1)).astype(np.float32)
+
+    rho_j, adv_j, vs_j = vtrace(
+        jnp.asarray(behav), jnp.asarray(target), jnp.asarray(fir),
+        jnp.asarray(rew), jnp.asarray(val), 0.99,
+    )
+    rho_n, adv_n, vs_n = np_vtrace(
+        behav, target, fir, rew, val, 0.99, 0.8, 0.1, 1.0
+    )
+    np.testing.assert_allclose(np.asarray(rho_j), rho_n, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(vs_j), vs_n, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(adv_j), adv_n, rtol=1e-4, atol=1e-5)
+
+
+def test_vtrace_on_policy_reduces_to_td_lambda_like(rng):
+    """With target == behavior, rho = c = 1 (within clip) and vs satisfies the
+    standard V-trace fixed point identity dv[t] = delta[t] + gamma*dv[t+1]."""
+    B, S = 3, 6
+    lp = rng.normal(size=(B, S, 1)).astype(np.float32)
+    rew = rng.normal(size=(B, S, 1)).astype(np.float32)
+    val = rng.normal(size=(B, S, 1)).astype(np.float32)
+    fir = np.zeros((B, S, 1), np.float32)
+    rho, adv, vs = vtrace(
+        jnp.asarray(lp), jnp.asarray(lp), jnp.asarray(fir),
+        jnp.asarray(rew), jnp.asarray(val), 0.9, rho_bar=1.0,
+    )
+    np.testing.assert_allclose(np.asarray(rho), np.ones((B, S - 1, 1)), rtol=1e-6)
+    dv = np.asarray(vs) - val
+    delta = rew[:, :-1] + 0.9 * val[:, 1:] - val[:, :-1]
+    for t in range(S - 1):
+        np.testing.assert_allclose(
+            dv[:, t], delta[:, t] + 0.9 * dv[:, t + 1], rtol=1e-4, atol=1e-5
+        )

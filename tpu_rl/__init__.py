@@ -1,0 +1,26 @@
+"""tpu_rl — a TPU-native distributed reinforcement-learning framework.
+
+A clean-room JAX/XLA re-design of the capabilities of
+``ymg1114/pytorch-distributed-reinforcement-learning`` (see /root/repo/SURVEY.md):
+an IMPALA-style actor–learner architecture with six algorithms (PPO, PPO-Continuous,
+IMPALA/V-trace, V-MPO, SAC, SAC-Continuous), a fleet of CPU env workers streaming
+trajectories over ZMQ through per-machine manager relays into a learner-host storage
+process, and a mesh-data-parallel TPU learner compiled with ``jax.jit``.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- ``tpu_rl.config``     — typed config, parameters/machines JSON loaders
+- ``tpu_rl.models``     — Flax policies: MLP torso -> lax.scan LSTM -> heads
+- ``tpu_rl.ops``        — pure-JAX GAE / V-trace / distributions / huber / polyak
+- ``tpu_rl.algos``      — jitted train_step per algorithm + registry
+- ``tpu_rl.data``       — trajectory assembly, shared-memory batch store, replay
+- ``tpu_rl.transport``  — ZMQ PUB/SUB wire protocol + codec (DCN path)
+- ``tpu_rl.agents``     — worker / manager / storage / learner processes
+- ``tpu_rl.parallel``   — device mesh, data-parallel shardings (ICI path)
+- ``tpu_rl.envs``       — Gym adapter + fake envs for tests
+- ``tpu_rl.utils``      — timers, checkpointing, logging, process supervision
+"""
+
+__version__ = "0.1.0"
+
+from tpu_rl.config import Config, MachinesConfig  # noqa: F401

@@ -1,0 +1,11 @@
+"""Jitted per-algorithm train steps + registry.
+
+Each algorithm is a pure ``train_step(state, batch, key) -> (state, metrics)``
+compiled once with ``jax.jit`` — the TPU-native replacement for the reference's
+asyncio update coroutines (``/root/reference/agents/learner_module/*/learning.py``).
+The surrounding IO loop (batch feed, weight broadcast, checkpoints) lives in
+``tpu_rl.agents.learner``.
+"""
+
+from tpu_rl.algos.base import TrainState, SACState, make_train_state  # noqa: F401
+from tpu_rl.algos.registry import get_algo, AlgoSpec  # noqa: F401

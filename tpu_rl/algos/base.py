@@ -1,0 +1,84 @@
+"""Train-state containers and optimizer factories."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from tpu_rl.config import Config
+from tpu_rl.models.families import ModelFamily
+
+
+@struct.dataclass
+class TrainState:
+    """State for the single-optimizer on-policy algorithms (PPO / IMPALA /
+    V-MPO). ``params`` is ``{"actor": tree}`` plus, for V-MPO, the trainable
+    Lagrange temperatures ``log_eta`` / ``log_alpha`` (reference
+    ``agents/learner.py:320-338``)."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+@struct.dataclass
+class SACState:
+    """State for the separate-network off-policy algorithms (SAC families):
+    actor / twin-critic / *separate* target-critic trees and an auto-tuned
+    temperature with its own optimizer (reference ``agents/learner.py:351-367``,
+    with the target-critic aliasing bug fixed — see ``ops.target``)."""
+
+    step: jax.Array
+    actor_params: Any
+    critic_params: Any
+    target_critic_params: Any
+    log_alpha: jax.Array
+    actor_opt: Any
+    critic_opt: Any
+    alpha_opt: Any
+
+
+def rmsprop(cfg: Config) -> optax.GradientTransformation:
+    """RMSprop matching torch semantics (``agents/learner.py:70``:
+    ``RMSprop(lr, eps=1e-5)`` with torch defaults alpha=0.99 and the epsilon
+    added outside the square root)."""
+    try:
+        return optax.rmsprop(cfg.lr, decay=0.99, eps=1e-5, eps_in_sqrt=False)
+    except TypeError:  # older optax without eps_in_sqrt
+        return optax.rmsprop(cfg.lr, decay=0.99, eps=1e-5)
+
+
+def adam(cfg: Config) -> optax.GradientTransformation:
+    """Adam with torch defaults (``agents/learner.py:360-367``)."""
+    return optax.adam(cfg.lr)
+
+
+def make_train_state(cfg: Config, family: ModelFamily, key: jax.Array):
+    """Build the initial state for ``cfg.algo``."""
+    params = family.init_params(key, seq_len=cfg.seq_len)
+    if family.separate:
+        opt_a, opt_c, opt_al = adam(cfg), adam(cfg), adam(cfg)
+        log_alpha = jnp.asarray(jnp.log(cfg.alpha), jnp.float32)
+        return SACState(
+            step=jnp.zeros((), jnp.int32),
+            actor_params=params["actor"],
+            critic_params=params["critic"],
+            target_critic_params=jax.tree_util.tree_map(
+                lambda x: x, params["critic"]
+            ),
+            log_alpha=log_alpha,
+            actor_opt=opt_a.init(params["actor"]),
+            critic_opt=opt_c.init(params["critic"]),
+            alpha_opt=opt_al.init(log_alpha),
+        )
+    if cfg.algo == "V-MPO":
+        init = jnp.log(jnp.asarray(cfg.v_mpo_lagrange_multiplier_init, jnp.float32))
+        params = {**params, "log_eta": init, "log_alpha": init}
+    opt = rmsprop(cfg)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=opt.init(params)
+    )

@@ -1,0 +1,77 @@
+"""IMPALA (V-trace actor-critic) train step.
+
+Functional re-design of ``/root/reference/agents/learner_module/impala/
+learning.py:13-114``: V-trace targets/advantages computed no-grad
+(rho in [0.1, 0.8], c_bar = 1.0, ``compute_loss.py:22-66``), policy-gradient
+loss ``-(log_probs * advantages)``, smooth-L1 value loss to the V-trace
+targets, entropy bonus — one jitted step with the V-trace recursion as a
+reverse ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_rl.algos.base import TrainState, rmsprop
+from tpu_rl.algos.ppo import policy_outputs
+from tpu_rl.config import Config
+from tpu_rl.models.families import ModelFamily
+from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
+from tpu_rl.ops.returns import vtrace
+from tpu_rl.types import Batch
+
+
+def make_train_step(cfg: Config, family: ModelFamily):
+    opt = rmsprop(cfg)
+
+    def loss_fn(params, batch: Batch):
+        log_probs, entropy, value, _ = policy_outputs(family, params, batch)
+
+        ratio, advantages, values_target = vtrace(
+            behav_log_probs=batch.log_prob,
+            target_log_probs=jax.lax.stop_gradient(log_probs),
+            is_fir=batch.is_fir,
+            rewards=batch.rew,
+            values=jax.lax.stop_gradient(value),
+            gamma=cfg.gamma,
+            rho_bar=cfg.rho_bar,
+            rho_min=cfg.rho_min,
+            c_bar=cfg.c_bar,
+        )
+
+        loss_policy = -jnp.mean(log_probs[:, :-1] * advantages)
+        loss_value = smooth_l1(value[:, :-1], values_target[:, :-1])
+        policy_entropy = jnp.mean(entropy[:, :-1])
+
+        loss = (
+            cfg.policy_loss_coef * loss_policy
+            + cfg.value_loss_coef * loss_value
+            - cfg.entropy_coef * policy_entropy
+        )
+        metrics = {
+            "loss": loss,
+            "policy-loss": loss_policy,
+            "value-loss": loss_value,
+            "policy-entropy": policy_entropy,
+            "min-ratio": jnp.min(ratio),
+            "max-ratio": jnp.max(ratio),
+            "avg-ratio": jnp.mean(ratio),
+        }
+        return loss, metrics
+
+    def train_step(state: TrainState, batch: Batch, key: jax.Array):
+        metrics = {}
+        for _ in range(cfg.K_epoch):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch
+            )
+            grads, gnorm = clip_subtree_by_global_norm(grads, cfg.max_grad_norm)
+            updates, opt_state = opt.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            state = state.replace(params=params, opt_state=opt_state)
+            metrics["grad-norm"] = gnorm
+        return state.replace(step=state.step + 1), metrics
+
+    return train_step

@@ -1,0 +1,99 @@
+"""PPO (discrete and continuous) train step.
+
+Functional re-design of ``/root/reference/agents/learner_module/ppo/learning.py:13-126``:
+the clipped-surrogate update with TD(lambda)/GAE advantages masked by
+``(1 - is_fir[:, 1:])``, smooth-L1 value loss against a no-grad TD target,
+entropy bonus, global-norm grad clip, RMSprop — all fused into one jitted step.
+``K_epoch`` epochs unroll statically inside the step (reference ``:36``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_rl.algos.base import TrainState, rmsprop
+from tpu_rl.config import Config
+from tpu_rl.models.families import ModelFamily
+from tpu_rl.ops import distributions as D
+from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
+from tpu_rl.ops.returns import gae
+from tpu_rl.types import Batch
+
+
+def policy_outputs(family: ModelFamily, params, batch: Batch):
+    """Shared-torso forward for the on-policy families. Returns
+    (log_probs (B,S,Alp), entropy (B,S,1), value (B,S,1), logits (B,S,A))."""
+    carry0 = (batch.hx[:, 0], batch.cx[:, 0])
+    if family.continuous:
+        mu, std, value, _ = family.actor_unroll(
+            params["actor"], batch.obs, carry0, batch.is_fir
+        )
+        log_probs = D.normal_log_prob(mu, std, batch.act)  # per-dim (B,S,A)
+        entropy = jnp.mean(D.normal_entropy(std), axis=-1, keepdims=True)
+        logits = jnp.zeros_like(mu)
+    else:
+        logits, value, _ = family.actor_unroll(
+            params["actor"], batch.obs, carry0, batch.is_fir
+        )
+        acts = batch.act[..., 0]
+        log_probs = D.categorical_log_prob(logits, acts)[..., None]
+        entropy = D.categorical_entropy(logits)[..., None]
+    return log_probs, entropy, value, logits
+
+
+def td_target_and_gae(cfg: Config, batch: Batch, value: jax.Array):
+    """No-grad TD target and GAE advantages (reference ``ppo/learning.py:48-57``)."""
+    v = jax.lax.stop_gradient(value)
+    td_target = batch.rew[:, :-1] + cfg.gamma * (1.0 - batch.is_fir[:, 1:]) * v[:, 1:]
+    delta = td_target - v[:, :-1]
+    return td_target, gae(delta, cfg.gamma, cfg.lmbda)
+
+
+def make_train_step(cfg: Config, family: ModelFamily):
+    opt = rmsprop(cfg)
+
+    def loss_fn(params, batch: Batch):
+        log_probs, entropy, value, _ = policy_outputs(family, params, batch)
+        td_target, advantage = td_target_and_gae(cfg, batch, value)
+
+        ratio = jnp.exp(log_probs[:, :-1] - batch.log_prob[:, :-1])
+        surr1 = ratio * advantage
+        surr2 = (
+            jnp.clip(ratio, 1.0 - cfg.eps_clip, 1.0 + cfg.eps_clip) * advantage
+        )
+        loss_policy = -jnp.mean(jnp.minimum(surr1, surr2))
+        loss_value = smooth_l1(value[:, :-1], td_target)
+        policy_entropy = jnp.mean(entropy[:, :-1])
+
+        loss = (
+            cfg.policy_loss_coef * loss_policy
+            + cfg.value_loss_coef * loss_value
+            - cfg.entropy_coef * policy_entropy
+        )
+        metrics = {
+            "loss": loss,
+            "policy-loss": loss_policy,
+            "value-loss": loss_value,
+            "policy-entropy": policy_entropy,
+            "min-ratio": jnp.min(ratio),
+            "max-ratio": jnp.max(ratio),
+            "avg-ratio": jnp.mean(ratio),
+        }
+        return loss, metrics
+
+    def train_step(state: TrainState, batch: Batch, key: jax.Array):
+        metrics = {}
+        for _ in range(cfg.K_epoch):
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch
+            )
+            grads, gnorm = clip_subtree_by_global_norm(grads, cfg.max_grad_norm)
+            updates, opt_state = opt.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            state = state.replace(params=params, opt_state=opt_state)
+            metrics["grad-norm"] = gnorm
+        return state.replace(step=state.step + 1), metrics
+
+    return train_step

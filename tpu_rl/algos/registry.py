@@ -1,0 +1,49 @@
+"""Algorithm registry.
+
+One declarative table replaces the reference's three parallel switch dicts
+(``/root/reference/main.py:98-110`` model/learner classes, ``:215-222``
+learning-chain coroutines, ``:310-321`` shared-memory factories).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+
+from tpu_rl.algos import impala, ppo, sac, vmpo
+from tpu_rl.algos.base import make_train_state
+from tpu_rl.config import Config
+from tpu_rl.models.families import ALGOS, ModelFamily, build_family
+
+
+@dataclass(frozen=True)
+class AlgoSpec:
+    name: str
+    on_policy: bool  # on-policy ring vs off-policy replay (main.py:310-321)
+    make_train_step: Callable[[Config, ModelFamily], Callable]
+
+    def build(self, cfg: Config, key: jax.Array):
+        """Returns (family, initial_state, train_step)."""
+        family = build_family(cfg)
+        state = make_train_state(cfg, family, key)
+        return family, state, self.make_train_step(cfg, family)
+
+
+_REGISTRY: dict[str, AlgoSpec] = {
+    "PPO": AlgoSpec("PPO", True, ppo.make_train_step),
+    "PPO-Continuous": AlgoSpec("PPO-Continuous", True, ppo.make_train_step),
+    "IMPALA": AlgoSpec("IMPALA", True, impala.make_train_step),
+    "V-MPO": AlgoSpec("V-MPO", True, vmpo.make_train_step),
+    "SAC": AlgoSpec("SAC", False, sac.make_train_step),
+    "SAC-Continuous": AlgoSpec("SAC-Continuous", False, sac.make_train_step),
+}
+
+assert set(_REGISTRY) == set(ALGOS)
+
+
+def get_algo(name: str) -> AlgoSpec:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown algo {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]

@@ -1,0 +1,154 @@
+"""SAC train steps (discrete and continuous).
+
+Functional re-design of ``/root/reference/agents/learner_module/sac/
+learning.py:13-163`` and ``sac_continuous/learning.py:13-151`` plus the
+``LearnerSeperate`` setup (``agents/learner.py:351-367``): three sequential
+optimizer updates per step (actor, temperature, twin critic), soft TD targets
+from a *separate* target critic (fixing the reference's self-aliasing no-op
+target, ``learner.py:355-358``), Polyak update tau=0.005
+(``compute_loss.py:69-71``), target entropy = action-space size
+(``learner.py:363-365``). All three updates fuse into one jitted step; the
+continuous variant reparameterizes through the tanh-squashed Gaussian with
+explicit RNG keys.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_rl.algos.base import SACState, adam
+from tpu_rl.config import Config
+from tpu_rl.models.families import ModelFamily
+from tpu_rl.ops.distributions import tanh_normal_sample
+from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
+from tpu_rl.ops.target import polyak_update
+from tpu_rl.types import Batch
+
+sg = jax.lax.stop_gradient
+
+
+def make_train_step(cfg: Config, family: ModelFamily):
+    opt_actor, opt_critic, opt_alpha = adam(cfg), adam(cfg), adam(cfg)
+    target_entropy = float(cfg.action_space)
+    continuous = family.continuous
+
+    def _critic_apply(cp, batch: Batch, act, carry0):
+        if continuous:
+            return family.critic_unroll(cp, batch.obs, act, carry0, batch.is_fir)
+        return family.critic_unroll(cp, batch.obs, carry0, batch.is_fir)
+
+    def one_epoch(state: SACState, batch: Batch, key: jax.Array):
+        carry0 = (batch.hx[:, 0], batch.cx[:, 0])
+        fir = batch.is_fir
+        k_pol, k_cri = jax.random.split(key)
+
+        # ---- 1) actor update (sac/learning.py:36-62, sac_continuous:35-55)
+        alpha_d = sg(jnp.exp(state.log_alpha))
+
+        def actor_loss(ap):
+            if continuous:
+                mu, log_std = family.actor_unroll(ap, batch.obs, carry0, fir)
+                a_pol, logp = tanh_normal_sample(k_pol, mu, jnp.exp(log_std))
+                q1, q2 = _critic_apply(state.critic_params, batch, a_pol, carry0)
+                min_q = jnp.minimum(q1, q2)
+                loss_policy = jnp.mean((alpha_d * logp - min_q)[:, :-1])
+                ent_neg = logp[:, :-1]  # per-dim -entropy estimate
+            else:
+                probs, logp = family.actor_unroll(ap, batch.obs, carry0, fir)
+                q1, q2 = _critic_apply(state.critic_params, batch, None, carry0)
+                min_q = jnp.minimum(q1, q2)
+                loss_policy = jnp.mean(
+                    jnp.sum((probs * (alpha_d * logp - min_q))[:, :-1], axis=-1)
+                )
+                ent_neg = jnp.sum((probs * logp)[:, :-1], axis=-1)
+            return loss_policy, ent_neg
+
+        (loss_policy, ent_neg), g_actor = jax.value_and_grad(
+            actor_loss, has_aux=True
+        )(state.actor_params)
+        g_actor, _ = clip_subtree_by_global_norm(g_actor, cfg.max_grad_norm)
+        up, actor_opt = opt_actor.update(g_actor, state.actor_opt, state.actor_params)
+        actor_params = optax.apply_updates(state.actor_params, up)
+
+        # ---- 2) temperature update (sac/learning.py:64-74)
+        def alpha_loss_fn(log_alpha):
+            return jnp.mean(jnp.exp(log_alpha) * (sg(ent_neg) + target_entropy))
+
+        loss_alpha, g_alpha = jax.value_and_grad(alpha_loss_fn)(state.log_alpha)
+        up, alpha_opt = opt_alpha.update(g_alpha, state.alpha_opt, state.log_alpha)
+        log_alpha = optax.apply_updates(state.log_alpha, up)
+
+        # ---- 3) critic update with updated actor + alpha (sac/learning.py:76-120)
+        alpha2 = sg(jnp.exp(log_alpha))
+        if continuous:
+            mu, log_std = family.actor_unroll(actor_params, batch.obs, carry0, fir)
+            a_cri, logp_cri = tanh_normal_sample(k_cri, mu, jnp.exp(log_std))
+            tq1, tq2 = _critic_apply(
+                state.target_critic_params, batch, a_cri, carry0
+            )
+            soft_q = jnp.minimum(tq1, tq2) - alpha2 * logp_cri
+        else:
+            probs_cri, logp_cri = family.actor_unroll(
+                actor_params, batch.obs, carry0, fir
+            )
+            tq1, tq2 = _critic_apply(state.target_critic_params, batch, None, carry0)
+            soft_q = probs_cri * (jnp.minimum(tq1, tq2) - alpha2 * logp_cri)
+        soft_q = sg(soft_q)
+        td_target = batch.rew[:, :-1] + (1.0 - fir[:, 1:]) * cfg.gamma * jnp.sum(
+            soft_q[:, 1:], axis=-1, keepdims=True
+        )
+
+        def critic_loss(cp):
+            if continuous:
+                q1, q2 = _critic_apply(cp, batch, batch.act, carry0)
+            else:
+                q1, q2 = _critic_apply(cp, batch, None, carry0)
+                a_idx = batch.act.astype(jnp.int32)
+                q1 = jnp.take_along_axis(q1, a_idx, axis=-1)
+                q2 = jnp.take_along_axis(q2, a_idx, axis=-1)
+            return smooth_l1(q1[:, :-1], td_target) + smooth_l1(
+                q2[:, :-1], td_target
+            )
+
+        loss_value, g_critic = jax.value_and_grad(critic_loss)(state.critic_params)
+        g_critic, _ = clip_subtree_by_global_norm(g_critic, cfg.max_grad_norm)
+        up, critic_opt = opt_critic.update(
+            g_critic, state.critic_opt, state.critic_params
+        )
+        critic_params = optax.apply_updates(state.critic_params, up)
+
+        # ---- 4) Polyak target update (a real one — see module docstring)
+        target_critic_params = polyak_update(
+            critic_params, state.target_critic_params, cfg.tau
+        )
+
+        metrics = {
+            "loss": cfg.policy_loss_coef * loss_policy
+            + cfg.value_loss_coef * loss_value,
+            "policy-loss": loss_policy,
+            "value-loss": loss_value,
+            "loss_alpha": loss_alpha,
+            "alpha": jnp.exp(log_alpha),
+        }
+        return (
+            state.replace(
+                actor_params=actor_params,
+                critic_params=critic_params,
+                target_critic_params=target_critic_params,
+                log_alpha=log_alpha,
+                actor_opt=actor_opt,
+                critic_opt=critic_opt,
+                alpha_opt=alpha_opt,
+            ),
+            metrics,
+        )
+
+    def train_step(state: SACState, batch: Batch, key: jax.Array):
+        metrics = {}
+        for e in range(cfg.K_epoch):
+            state, metrics = one_epoch(state, batch, jax.random.fold_in(key, e))
+        return state.replace(step=state.step + 1), metrics
+
+    return train_step

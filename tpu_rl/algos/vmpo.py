@@ -1,0 +1,117 @@
+"""V-MPO train step.
+
+Functional re-design of ``/root/reference/agents/learner_module/v_mpo/
+learning.py:14-144`` plus the Lagrange-temperature machinery of
+``LearnerSingleVMPO`` (``agents/learner.py:320-348``):
+
+- GAE advantages (no-grad), then **top-half selection over the batch axis**
+  per time step (``v_mpo/learning.py:60-64``),
+- softmax weights psi over the flattened selected advantages / eta
+  (``:66-74``), weighted maximum-likelihood policy loss,
+- temperature loss ``eta*coef_eta + eta*log(mean(exp(ratio)))`` (``:82-85``),
+- KL Lagrange loss with a per-update log-uniform-sampled KL bound
+  (``:87-92``, ``learner.py:340-348``) — sampled inside the step from the
+  explicit RNG key,
+- one RMSprop over model + log_eta + log_alpha, grad-clip on the model
+  subtree only (``:108-114``, ``learner.py:331-338``).
+
+The top-k runs over the *global* batch inside ``jit``, so under a data-sharded
+mesh XLA inserts the cross-chip gather — the per-batch statistics stay exact
+(BASELINE.md config 5 stresses exactly this).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_rl.algos.base import TrainState, rmsprop
+from tpu_rl.algos.ppo import policy_outputs, td_target_and_gae
+from tpu_rl.config import Config
+from tpu_rl.models.families import ModelFamily
+from tpu_rl.ops.distributions import categorical_kl
+from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
+from tpu_rl.types import Batch
+
+
+def _topk_batch_axis(x: jax.Array, k: int):
+    """``torch.topk(x, k, dim=0)`` for x of shape (B, T, 1)."""
+    xm = jnp.moveaxis(x, 0, -1)  # (T, 1, B)
+    vals, idx = jax.lax.top_k(xm, k)  # (T, 1, K)
+    return jnp.moveaxis(vals, -1, 0), jnp.moveaxis(idx, -1, 0)  # (K, T, 1)
+
+
+def make_train_step(cfg: Config, family: ModelFamily):
+    opt = rmsprop(cfg)
+
+    def loss_fn(params, batch: Batch, key: jax.Array):
+        log_probs, _entropy, value, logits = policy_outputs(family, params, batch)
+        td_target, advantage = td_target_and_gae(cfg, batch, value)
+
+        eta = jnp.exp(params["log_eta"])
+        alpha = jnp.exp(params["log_alpha"])
+
+        # top 50% of the *actual* batch per time step (v_mpo/learning.py:60-64)
+        top_gae, top_idx = _topk_batch_axis(
+            advantage, math.ceil(batch.batch_size / 2)
+        )
+        ratio = top_gae / (jax.lax.stop_gradient(eta) + 1e-7)  # no-grad
+        top_log_probs = jnp.take_along_axis(log_probs[:, :-1], top_idx, axis=0)
+
+        psi = jax.nn.softmax(ratio.reshape(-1)).reshape(ratio.shape)
+        loss_policy = -jnp.sum(psi * top_log_probs)
+
+        loss_value = smooth_l1(value[:, :-1], td_target)
+
+        loss_temperature = eta * cfg.coef_eta + eta * jnp.log(
+            jnp.mean(jnp.exp(ratio))
+        )
+
+        # per-update KL bound, log-uniform in [coef_alpha_below, coef_alpha_upper]
+        lo, hi = math.log(cfg.coef_alpha_below), math.log(cfg.coef_alpha_upper)
+        coef_alpha = jnp.exp(jax.random.uniform(key, (), minval=lo, maxval=hi))
+
+        kl = categorical_kl(batch.logits[:, :-1], logits[:, :-1])
+        loss_alpha = jnp.mean(
+            alpha * (coef_alpha - jax.lax.stop_gradient(kl))
+            + jax.lax.stop_gradient(alpha) * kl
+        )
+
+        loss = (
+            cfg.policy_loss_coef * loss_policy
+            + cfg.value_loss_coef * loss_value
+            + loss_temperature
+            + loss_alpha
+        )
+        metrics = {
+            "loss": loss,
+            "policy-loss": loss_policy,
+            "value-loss": loss_value,
+            "loss-temperature": loss_temperature,
+            "loss-alpha": loss_alpha,
+            "eta": eta,
+            "vmpo-alpha": alpha,
+            "kl": jnp.mean(kl),
+        }
+        return loss, metrics
+
+    def train_step(state: TrainState, batch: Batch, key: jax.Array):
+        metrics = {}
+        for e in range(cfg.K_epoch):
+            ekey = jax.random.fold_in(key, e)
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, batch, ekey
+            )
+            grads, gnorm = clip_subtree_by_global_norm(
+                grads, cfg.max_grad_norm, subtree="actor"
+            )
+            updates, opt_state = opt.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            state = state.replace(params=params, opt_state=opt_state)
+            metrics["grad-norm"] = gnorm
+        return state.replace(step=state.step + 1), metrics
+
+    return train_step

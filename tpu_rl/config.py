@@ -1,0 +1,185 @@
+"""Typed configuration.
+
+Replaces the reference's import-time module-global ``SimpleNamespace`` config
+(``/root/reference/utils/utils.py:24-44`` loading ``utils/parameters.json`` and
+``utils/machines.json``) with explicit dataclasses, loadable from the same JSON
+shapes, plus validation. Runtime-derived fields (obs/action spaces) live here too
+instead of being mutated onto the global namespace (``/root/reference/main.py:66-95``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class Config:
+    """Hyperparameters. Field names/defaults match the reference's
+    ``utils/parameters.json:1-32`` so existing config files load unchanged."""
+
+    # experiment
+    env: str = "CartPole-v1"
+    algo: str = "PPO"
+    result_dir: str | None = None
+    model_dir: str | None = None
+
+    # observation preprocessing (conv path; parity with the reference's unused flags)
+    need_conv: bool = False
+    height: int = 84
+    width: int = 84
+    is_gray: bool = False
+
+    # model
+    hidden_size: int = 64
+
+    # rollout
+    time_horizon: int = 500
+    reward_scale: float = 0.1
+    seq_len: int = 5
+    batch_size: int = 128
+
+    # returns / losses
+    gamma: float = 0.99
+    lmbda: float = 0.95
+    eps_clip: float = 0.1
+    policy_loss_coef: float = 1.0
+    value_loss_coef: float = 0.5
+    entropy_coef: float = 0.00005
+
+    # SAC
+    alpha: float = 0.2
+    tau: float = 0.005
+
+    # V-trace clipping (reference hard-codes rho in [0.1, 0.8], c_bar = 1.0,
+    # /root/reference/agents/learner_module/compute_loss.py:29-43)
+    rho_bar: float = 0.8
+    rho_min: float = 0.1
+    c_bar: float = 1.0
+
+    # V-MPO
+    v_mpo_lagrange_multiplier_init: float = 5.0
+    coef_eta: float = 0.01
+    coef_alpha_upper: float = 0.01
+    coef_alpha_below: float = 0.005
+
+    # replay
+    buffer_size: int = 10240
+
+    # optimization
+    K_epoch: int = 1
+    lr: float = 0.0001
+    max_grad_norm: float = 40.0
+
+    # logging / checkpoints
+    loss_log_interval: int = 50
+    model_save_interval: int = 100
+
+    # ---- TPU-native knobs (new capability; no reference equivalent) ----
+    # Reset the LSTM carry at in-sequence episode seams (the reference does not:
+    # /root/reference/networks/models.py:71-75 carries state straight through
+    # spliced trajectories). Default True = the fix; set False for bit-parity.
+    reset_carry_on_first: bool = True
+    # Data-parallel mesh size for the learner (1 = single chip).
+    mesh_data: int = 1
+    # Compute dtype for the train step ("float32" or "bfloat16").
+    compute_dtype: str = "float32"
+    # Worker step throttle, seconds (reference hard-codes 0.05:
+    # /root/reference/agents/worker.py:131). 0 disables.
+    worker_step_sleep: float = 0.05
+    # RolloutAssembler idle-trajectory drop window, seconds
+    # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
+    rollout_lag_sec: float = 0.5
+
+    # ---- runtime-derived (filled by the runner, not the JSON) ----
+    obs_shape: tuple[int, ...] = (4,)
+    action_space: int = 2
+    is_continuous: bool = False
+
+    @classmethod
+    def from_json(cls, path: str | os.PathLike, **overrides: Any) -> "Config":
+        with open(path) as f:
+            raw = json.load(f)
+        return cls.from_dict({**raw, **overrides})
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "Config":
+        names = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in raw.items() if k in names}
+        cfg = cls(**kwargs)
+        cfg.validate()
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def validate(self) -> None:
+        assert self.seq_len >= 2, "seq_len must be >= 2 (losses bootstrap from t+1)"
+        assert self.batch_size >= 1
+        assert self.buffer_size >= self.batch_size
+        assert 0.0 <= self.gamma <= 1.0
+        assert 0.0 <= self.lmbda <= 1.0
+        assert self.compute_dtype in (
+            "float32",
+            "bfloat16",
+        ), f"compute_dtype must be float32 or bfloat16, got {self.compute_dtype!r}"
+
+    def replace(self, **kw: Any) -> "Config":
+        new = dataclasses.replace(self, **kw)
+        new.validate()
+        return new
+
+
+@dataclass
+class WorkerMachine:
+    """One actor machine entry (reference ``utils/machines.json:6-25``)."""
+
+    num_p: int = 2
+    manager_ip: str = "127.0.0.1"
+    ip: str = "127.0.0.1"
+    port: int = 27165
+
+
+@dataclass
+class MachinesConfig:
+    """Cluster topology (reference ``utils/machines.json`` via
+    ``utils/utils.py:30-44``)."""
+
+    learner_ip: str = "127.0.0.1"
+    learner_port: int = 47165
+    workers: list[WorkerMachine] = field(default_factory=lambda: [WorkerMachine()])
+
+    @classmethod
+    def from_json(cls, path: str | os.PathLike) -> "MachinesConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "MachinesConfig":
+        learner = raw.get("learner", {})
+        workers = [WorkerMachine(**w) for w in raw.get("workers", [])]
+        return cls(
+            learner_ip=learner.get("ip", "127.0.0.1"),
+            learner_port=int(learner.get("port", 47165)),
+            workers=workers or [WorkerMachine()],
+        )
+
+    @property
+    def model_port(self) -> int:
+        """Model-broadcast port = learner_port + 1 (reference
+        ``agents/learner.py:88-90``)."""
+        return self.learner_port + 1
+
+
+def default_result_dirs(base: str = "results") -> tuple[str, str]:
+    """Timestamped result/model dirs (reference ``utils/utils.py:79-81``)."""
+    import datetime
+
+    ts = datetime.datetime.now().strftime("%d%m%Y-%H_%M_%S")
+    result_dir = os.path.join(base, ts)
+    model_dir = os.path.join(result_dir, "models")
+    return result_dir, model_dir

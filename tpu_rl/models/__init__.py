@@ -1,0 +1,21 @@
+"""Flax model zoo: MLP torso -> scanned LSTM -> policy/value heads.
+
+TPU-native re-design of the reference's ten torch modules
+(``/root/reference/networks/models.py``): the per-step ``nn.LSTMCell`` Python
+unroll (``models.py:71-75``) becomes a single ``nn.scan`` over the time axis;
+the "Single" composites' aliased actor/critic object
+(``models.py:345-361``) becomes one parameter tree with two heads; SAC's twin
+critics are separate submodules and the target critic is a genuinely separate
+parameter copy (fixing the aliasing bug at ``agents/learner.py:355-358``).
+"""
+
+from tpu_rl.models.cells import LSTMCell  # noqa: F401
+from tpu_rl.models.policies import (  # noqa: F401
+    DiscreteActorCritic,
+    ContinuousActorCritic,
+    SACDiscreteActor,
+    SACDiscreteTwinCritic,
+    SACContinuousActor,
+    SACContinuousTwinCritic,
+)
+from tpu_rl.models.families import ModelFamily, build_family  # noqa: F401

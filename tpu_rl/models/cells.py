@@ -1,0 +1,36 @@
+"""Recurrent cells."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Carry = tuple[jax.Array, jax.Array]
+
+
+class LSTMCell(nn.Module):
+    """A standard LSTM cell with torch ``nn.LSTMCell`` gate semantics
+    (i, f, g, o; ``c' = f*c + i*g``; ``h' = o*tanh(c')``) — the recurrent core
+    the whole reference model zoo is built on
+    (``/root/reference/networks/models.py:25-27``).
+
+    One fused Dense over ``[x, h]`` produces all four gates, so the per-step
+    compute is a single (in+H, 4H) matmul that XLA maps onto the MXU.
+    """
+
+    hidden: int
+
+    @nn.compact
+    def __call__(self, carry: Carry, x: jax.Array) -> tuple[Carry, jax.Array]:
+        h, c = carry
+        z = nn.Dense(4 * self.hidden, name="gates")(jnp.concatenate([x, h], axis=-1))
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c2 = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+        h2 = nn.sigmoid(o) * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    @staticmethod
+    def zero_carry(hidden: int, batch_shape: tuple[int, ...] = ()) -> Carry:
+        z = jnp.zeros((*batch_shape, hidden), jnp.float32)
+        return (z, z)

@@ -1,0 +1,150 @@
+"""Model-family registry: algo name -> modules + pure init/act/unroll fns.
+
+Replaces the reference's ``module_switcher`` class table
+(``/root/reference/main.py:98-110``) with a declarative registry. Each family
+bundles the Flax modules with *pure functions* used by workers (single-step
+``act`` with explicit RNG) and learners (sequence ``unroll``), so every consumer
+jits against plain ``(params, arrays)`` signatures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_rl.config import Config
+from tpu_rl.ops import distributions as D
+from tpu_rl.models.policies import (
+    ContinuousActorCritic,
+    DiscreteActorCritic,
+    SACContinuousActor,
+    SACContinuousTwinCritic,
+    SACDiscreteActor,
+    SACDiscreteTwinCritic,
+)
+
+Params = Any
+
+
+@dataclass(frozen=True)
+class ModelFamily:
+    """One algorithm's model bundle.
+
+    ``act(params, obs, h, c, key)`` mirrors the reference worker step contract
+    (``/root/reference/agents/worker.py:105-123``): returns
+    ``(action, behavior_logits, log_prob, h', c')`` where ``action`` is a
+    float vector ((1,) index for discrete, (A,) for continuous), ``logits`` is
+    the (A,) log-softmax (zeros for Gaussian policies, ``models.py:46-49``),
+    and ``log_prob`` is (1,) discrete / (A,) per-dim continuous.
+    """
+
+    algo: str
+    continuous: bool
+    separate: bool
+    actor: nn.Module
+    critic: nn.Module | None
+    obs_dim: int
+    n_actions: int
+    hidden: int
+    act: Callable[..., tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]] = (
+        field(repr=False, default=None)
+    )
+
+    # -------------------------------------------------------------- builders
+    def init_params(self, key: jax.Array, seq_len: int = 2) -> Params:
+        """Initialize the full parameter tree: ``{"actor": ...}`` for
+        shared-torso families, ``{"actor": ..., "critic": ...}`` for SAC."""
+        obs = jnp.zeros((1, seq_len, self.obs_dim))
+        firsts = jnp.zeros((1, seq_len, 1))
+        carry = (jnp.zeros((1, self.hidden)), jnp.zeros((1, self.hidden)))
+        ka, kc = jax.random.split(key)
+        params = {"actor": self.actor.init(ka, obs, carry, firsts)}
+        if self.critic is not None:
+            if self.continuous:
+                act = jnp.zeros((1, seq_len, self.n_actions))
+                params["critic"] = self.critic.init(kc, obs, act, carry, firsts)
+            else:
+                params["critic"] = self.critic.init(kc, obs, carry, firsts)
+        return params
+
+    # --------------------------------------------------------------- applies
+    def actor_unroll(self, actor_params, obs, carry0, firsts):
+        return self.actor.apply(actor_params, obs, carry0, firsts)
+
+    def critic_unroll(self, critic_params, *args):
+        assert self.critic is not None
+        return self.critic.apply(critic_params, *args)
+
+
+# ---------------------------------------------------------------- act fns
+def _act_discrete_ac(actor: DiscreteActorCritic, params, obs, h, c, key):
+    logits, _v, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
+    a = D.categorical_sample(key, logits)
+    log_prob = D.categorical_log_prob(logits, a)
+    return a[..., None].astype(jnp.float32), logits, log_prob[..., None], h2, c2
+
+
+def _act_continuous_ac(actor: ContinuousActorCritic, params, obs, h, c, key):
+    mu, std, _v, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
+    a = D.normal_sample(key, mu, std)
+    log_prob = D.normal_log_prob(mu, std, a)
+    return a, jnp.zeros_like(mu), log_prob, h2, c2
+
+
+def _act_sac_discrete(actor: SACDiscreteActor, params, obs, h, c, key):
+    logits, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
+    a = D.categorical_sample(key, logits)
+    log_prob = D.categorical_log_prob(logits, a)
+    return a[..., None].astype(jnp.float32), logits, log_prob[..., None], h2, c2
+
+
+def _act_sac_continuous(actor: SACContinuousActor, params, obs, h, c, key):
+    mu, log_std, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
+    a, log_prob = D.tanh_normal_sample(key, mu, jnp.exp(log_std))
+    return a, jnp.zeros_like(mu), log_prob, h2, c2
+
+
+def build_family(cfg: Config) -> ModelFamily:
+    """Build the model family for ``cfg.algo`` (registry equivalent of
+    ``main.py:98-110``)."""
+    obs_dim = int(cfg.obs_shape[0])
+    n = int(cfg.action_space)
+    kw = dict(hidden=cfg.hidden_size, reset_on_first=cfg.reset_carry_on_first)
+
+    if cfg.algo in ("PPO", "IMPALA", "V-MPO"):
+        actor = DiscreteActorCritic(n_actions=n, **kw)
+        fam = ModelFamily(
+            cfg.algo, False, False, actor, None, obs_dim, n, cfg.hidden_size,
+            act=partial(_act_discrete_ac, actor),
+        )
+    elif cfg.algo == "PPO-Continuous":
+        actor = ContinuousActorCritic(n_actions=n, **kw)
+        fam = ModelFamily(
+            cfg.algo, True, False, actor, None, obs_dim, n, cfg.hidden_size,
+            act=partial(_act_continuous_ac, actor),
+        )
+    elif cfg.algo == "SAC":
+        actor = SACDiscreteActor(n_actions=n, **kw)
+        critic = SACDiscreteTwinCritic(n_actions=n, **kw)
+        fam = ModelFamily(
+            cfg.algo, False, True, actor, critic, obs_dim, n, cfg.hidden_size,
+            act=partial(_act_sac_discrete, actor),
+        )
+    elif cfg.algo == "SAC-Continuous":
+        actor = SACContinuousActor(n_actions=n, **kw)
+        critic = SACContinuousTwinCritic(**kw)
+        fam = ModelFamily(
+            cfg.algo, True, True, actor, critic, obs_dim, n, cfg.hidden_size,
+            act=partial(_act_sac_continuous, actor),
+        )
+    else:
+        raise ValueError(f"unknown algo {cfg.algo!r}")
+    return fam
+
+
+ALGOS = ("PPO", "PPO-Continuous", "IMPALA", "V-MPO", "SAC", "SAC-Continuous")

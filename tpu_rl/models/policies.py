@@ -1,0 +1,275 @@
+"""Policy / critic modules.
+
+Equivalents of the reference model zoo (``/root/reference/networks/models.py``),
+re-architected for XLA:
+
+- The per-step LSTM Python loop (``models.py:71-75``) is one ``nn.scan`` over
+  the time axis — a single compiled program regardless of sequence length, so
+  the same module family scales from the reference's seq-5 windows to long
+  sequences.
+- Modules return distribution *parameters* (log-softmax logits / mu, std);
+  sampling and log-prob math live in ``tpu_rl.ops.distributions`` with explicit
+  RNG keys (the reference leans on global torch RNG).
+- ``reset_on_first`` optionally zeroes the carried LSTM state at in-sequence
+  episode seams (``is_fir`` flags). The reference does NOT reset mid-sequence
+  (state flows across spliced trajectories, ``models.py:71-75`` +
+  ``buffers/rollout_assembler.py:61-67``); default True is our documented fix,
+  set False for bit-parity.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_rl.models.cells import Carry, LSTMCell
+
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
+
+
+def scan_lstm(
+    cell: LSTMCell,
+    x: jax.Array,
+    carry0: Carry,
+    firsts: jax.Array,
+    reset_on_first: bool,
+) -> tuple[Carry, jax.Array]:
+    """Unroll ``cell`` over the time axis (axis 1 of ``x``: (B, S, H)).
+
+    ``firsts`` is (B, S, 1); when ``reset_on_first`` the carry is zeroed at
+    steps flagged as episode-first before the cell is applied.
+    """
+
+    def step(cell, carry, xs):
+        xt, ft = xs
+        if reset_on_first:
+            h, c = carry
+            keep = 1.0 - ft
+            carry = (h * keep, c * keep)
+        return cell(carry, xt)
+
+    scanner = nn.scan(
+        step,
+        variable_broadcast="params",
+        split_rngs={"params": False},
+        in_axes=1,
+        out_axes=1,
+    )
+    return scanner(cell, carry0, (x, firsts))
+
+
+class DiscreteActorCritic(nn.Module):
+    """Shared-torso categorical actor-critic: the reference's ``MlpLSTMBase``
+    inside the ``MlpLSTMSingle`` composite (``models.py:8-100,345-351``). The
+    reference aliases actor and critic to one object; here that is simply one
+    module with a logits head and a value head on a shared torso+LSTM."""
+
+    n_actions: int
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        self.body = nn.Dense(self.hidden, name="body")
+        self.cell = LSTMCell(self.hidden, name="cell")
+        self.logits_head = nn.Dense(self.n_actions, name="logits")
+        self.value_head = nn.Dense(1, name="value")
+
+    def act(self, obs: jax.Array, carry: Carry):
+        """Single-step inference (worker hot path, ``models.py:37-56``).
+        Returns (log-softmax logits, value, new carry); sampling is external."""
+        x = nn.relu(self.body(obs))
+        carry, h = self.cell(carry, x)
+        return jax.nn.log_softmax(self.logits_head(h)), self.value_head(h), carry
+
+    def unroll(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
+        """Batched sequence forward (``models.py:63-100``): obs (B, S, D),
+        carry0 ((B,H),(B,H)), firsts (B, S, 1) ->
+        (logits (B,S,A) log-softmax, value (B,S,1), carry)."""
+        x = nn.relu(self.body(obs))
+        carry, hs = scan_lstm(self.cell, x, carry0, firsts, self.reset_on_first)
+        return jax.nn.log_softmax(self.logits_head(hs)), self.value_head(hs), carry
+
+    __call__ = unroll
+
+
+class ContinuousActorCritic(nn.Module):
+    """Shared-torso Gaussian actor-critic: ``MlpLSTMContinuous`` in the
+    ``MlpLSTMSingleContinuous`` composite (``models.py:103-118,354-361``).
+    mu = tanh(Dense), std = softplus(Dense)."""
+
+    n_actions: int
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        self.body = nn.Dense(self.hidden, name="body")
+        self.cell = LSTMCell(self.hidden, name="cell")
+        self.mu_head = nn.Dense(self.n_actions, name="mu")
+        self.std_head = nn.Dense(self.n_actions, name="std")
+        self.value_head = nn.Dense(1, name="value")
+
+    def _dist(self, h: jax.Array):
+        mu = jnp.tanh(self.mu_head(h))
+        std = nn.softplus(self.std_head(h))
+        return mu, std
+
+    def act(self, obs: jax.Array, carry: Carry):
+        x = nn.relu(self.body(obs))
+        carry, h = self.cell(carry, x)
+        mu, std = self._dist(h)
+        return mu, std, self.value_head(h), carry
+
+    def unroll(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
+        x = nn.relu(self.body(obs))
+        carry, hs = scan_lstm(self.cell, x, carry0, firsts, self.reset_on_first)
+        mu, std = self._dist(hs)
+        return mu, std, self.value_head(hs), carry
+
+    __call__ = unroll
+
+
+class SACDiscreteActor(nn.Module):
+    """Categorical SAC actor (``MlpLSTMActor``, ``models.py:121-159``).
+    Returns (probs, log_probs) over actions; log via log-softmax (numerically
+    safe version of the reference's ``log(probs + 1e-8·[p==0])``)."""
+
+    n_actions: int
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        self.body = nn.Dense(self.hidden, name="body")
+        self.cell = LSTMCell(self.hidden, name="cell")
+        self.logits_head = nn.Dense(self.n_actions, name="logits")
+
+    def act(self, obs: jax.Array, carry: Carry):
+        x = nn.relu(self.body(obs))
+        carry, h = self.cell(carry, x)
+        return jax.nn.log_softmax(self.logits_head(h)), carry
+
+    def unroll(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
+        x = nn.relu(self.body(obs))
+        _, hs = scan_lstm(self.cell, x, carry0, firsts, self.reset_on_first)
+        logp = jax.nn.log_softmax(self.logits_head(hs))
+        return jnp.exp(logp), logp
+
+    __call__ = unroll
+
+
+class SACDiscreteCritic(nn.Module):
+    """Per-action Q critic (``MlpLSTMCritic``, ``models.py:234-270``)."""
+
+    n_actions: int
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        self.body = nn.Dense(self.hidden, name="body")
+        self.cell = LSTMCell(self.hidden, name="cell")
+        self.q_head = nn.Dense(self.n_actions, name="q")
+
+    def __call__(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
+        x = nn.relu(self.body(obs))
+        _, hs = scan_lstm(self.cell, x, carry0, firsts, self.reset_on_first)
+        return self.q_head(hs)
+
+
+class SACDiscreteTwinCritic(nn.Module):
+    """Twin per-action Q critics (``MlpLSTMDoubleCritic``,
+    ``models.py:335-342``) as genuinely separate parameter trees."""
+
+    n_actions: int
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        kw = dict(
+            n_actions=self.n_actions,
+            hidden=self.hidden,
+            reset_on_first=self.reset_on_first,
+        )
+        self.q1 = SACDiscreteCritic(name="q1", **kw)
+        self.q2 = SACDiscreteCritic(name="q2", **kw)
+
+    def __call__(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
+        return self.q1(obs, carry0, firsts), self.q2(obs, carry0, firsts)
+
+
+class SACContinuousActor(nn.Module):
+    """Tanh-squashed Gaussian SAC actor (``MlpLSTMActorContinuous``,
+    ``models.py:162-231``). Returns (mu, log_std clamped to [-20, 2], carry);
+    reparameterized sampling happens in ``ops.distributions.tanh_normal_sample``
+    with an explicit key."""
+
+    n_actions: int
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        self.body = nn.Dense(self.hidden, name="body")
+        self.cell = LSTMCell(self.hidden, name="cell")
+        self.mu_head = nn.Dense(self.n_actions, name="mu")
+        self.log_std_head = nn.Dense(self.n_actions, name="log_std")
+
+    def _dist(self, h: jax.Array):
+        mu = self.mu_head(h)
+        log_std = jnp.clip(self.log_std_head(h), LOG_STD_MIN, LOG_STD_MAX)
+        return mu, log_std
+
+    def act(self, obs: jax.Array, carry: Carry):
+        x = nn.relu(self.body(obs))
+        carry, h = self.cell(carry, x)
+        mu, log_std = self._dist(h)
+        return mu, log_std, carry
+
+    def unroll(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
+        x = nn.relu(self.body(obs))
+        _, hs = scan_lstm(self.cell, x, carry0, firsts, self.reset_on_first)
+        return self._dist(hs)
+
+    __call__ = unroll
+
+
+class SACContinuousCritic(nn.Module):
+    """Two-stream (obs, action) Q critic (``MlpLSTMCriticContinuous``,
+    ``models.py:273-322``): half-width obs and action encoders concatenated
+    into the LSTM, scalar Q head."""
+
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        half = self.hidden // 2
+        self.obs_body = nn.Dense(half, name="obs_body")
+        self.act_body = nn.Dense(half, name="act_body")
+        self.cell = LSTMCell(self.hidden, name="cell")
+        self.q_head = nn.Dense(1, name="q")
+
+    def __call__(
+        self, obs: jax.Array, act: jax.Array, carry0: Carry, firsts: jax.Array
+    ):
+        x = jnp.concatenate(
+            [nn.relu(self.obs_body(obs)), nn.relu(self.act_body(act))], axis=-1
+        )
+        _, hs = scan_lstm(self.cell, x, carry0, firsts, self.reset_on_first)
+        return self.q_head(hs)
+
+
+class SACContinuousTwinCritic(nn.Module):
+    """Twin continuous critics (``MlpLSTMDoubleCriticContinuous``,
+    ``models.py:325-332``)."""
+
+    hidden: int = 64
+    reset_on_first: bool = True
+
+    def setup(self):
+        kw = dict(hidden=self.hidden, reset_on_first=self.reset_on_first)
+        self.q1 = SACContinuousCritic(name="q1", **kw)
+        self.q2 = SACContinuousCritic(name="q2", **kw)
+
+    def __call__(
+        self, obs: jax.Array, act: jax.Array, carry0: Carry, firsts: jax.Array
+    ):
+        return self.q1(obs, act, carry0, firsts), self.q2(obs, act, carry0, firsts)

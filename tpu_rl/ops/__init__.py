@@ -1,0 +1,10 @@
+"""Pure-JAX numerical ops: returns (GAE, V-trace), distribution math, losses,
+and target-network updates. Everything here is functional, shape-static, and
+jit/scan-friendly — the TPU-native replacement for the reference's Python
+reverse-time loops (``/root/reference/agents/learner_module/compute_loss.py``)
+and ``torch.distributions`` usage."""
+
+from tpu_rl.ops.returns import gae, vtrace  # noqa: F401
+from tpu_rl.ops.losses import smooth_l1, categorical_kl  # noqa: F401
+from tpu_rl.ops.target import polyak_update  # noqa: F401
+from tpu_rl.ops import distributions  # noqa: F401

@@ -1,0 +1,82 @@
+"""Distribution math as pure functions with explicit RNG keys.
+
+Replaces the reference's ``torch.distributions`` usage
+(``/root/reference/networks/models.py:58-61,114-118,199-214``) with jit-safe
+primitives. Conventions kept for behavior parity:
+
+- "logits" stored in trajectories are **log-softmax** values, matching torch's
+  ``Categorical(probs).logits`` (``models.py:46-49``).
+- Normal log-probs are **per-dimension** (not summed), matching
+  ``dist.log_prob`` on a (..., A) event (``models.py:86``).
+- Tanh-squash correction uses ``log(1 - tanh(x)^2 + 1e-7)`` per dimension
+  (``models.py:205-214``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+# ---------------------------------------------------------------- categorical
+def categorical_sample(key: jax.Array, logits: jax.Array) -> jax.Array:
+    """Sample action indices from (unnormalized or log-softmax) logits."""
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def categorical_log_prob(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    """log pi(a) for integer ``actions`` (..., ) given logits (..., A)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, actions[..., None].astype(jnp.int32), axis=-1)[
+        ..., 0
+    ]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    p = jnp.exp(logp)
+    return -jnp.sum(p * logp, axis=-1)
+
+
+def categorical_kl(logits_p: jax.Array, logits_q: jax.Array) -> jax.Array:
+    """KL(p || q) over the last axis (reference ``compute_loss.py:74-77``)."""
+    logp = jax.nn.log_softmax(logits_p, axis=-1)
+    logq = jax.nn.log_softmax(logits_q, axis=-1)
+    p = jnp.exp(logp)
+    return jnp.sum(p * (logp - logq), axis=-1)
+
+
+# ------------------------------------------------------------------- gaussian
+def normal_sample(key: jax.Array, mu: jax.Array, std: jax.Array) -> jax.Array:
+    return mu + std * jax.random.normal(key, mu.shape, mu.dtype)
+
+
+def normal_log_prob(mu: jax.Array, std: jax.Array, x: jax.Array) -> jax.Array:
+    """Per-dimension Normal log-density (torch ``Normal.log_prob`` parity)."""
+    var = std * std
+    return -0.5 * (jnp.square(x - mu) / var + 2.0 * jnp.log(std) + _LOG_2PI)
+
+
+def normal_entropy(std: jax.Array) -> jax.Array:
+    """Per-dimension Normal entropy."""
+    return 0.5 * (1.0 + _LOG_2PI) + jnp.log(std)
+
+
+# ---------------------------------------------------------------- tanh-normal
+def tanh_normal_sample(
+    key: jax.Array, mu: jax.Array, std: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Reparameterized tanh-squashed Gaussian sample and per-dim log-prob.
+
+    Matches the reference SAC-continuous actor (``models.py:205-214``):
+    ``a = tanh(x), x ~ N(mu, std)``;
+    ``log_prob = logN(x) - log(1 - a^2 + 1e-7)`` per dimension.
+    """
+    x = normal_sample(key, mu, std)
+    action = jnp.tanh(x)
+    log_prob = normal_log_prob(mu, std, x) - jnp.log(1.0 - jnp.square(action) + 1e-7)
+    return action, log_prob

@@ -1,0 +1,20 @@
+"""Target-network updates."""
+
+from __future__ import annotations
+
+import jax
+
+
+def polyak_update(source_params, target_params, tau: float = 0.005):
+    """Soft (Polyak) target update: ``t <- (1 - tau) * t + tau * s``.
+
+    Functional equivalent of the reference's in-place ``soft_update``
+    (``/root/reference/agents/learner_module/compute_loss.py:69-71``) — and,
+    unlike the reference, it acts on a genuinely separate target tree (the
+    reference's ``target_critic`` aliases ``critic`` via ``.to()`` returning
+    self, ``agents/learner.py:355-358``, making its soft update a no-op;
+    documented divergence / bug fix).
+    """
+    return jax.tree_util.tree_map(
+        lambda s, t: (1.0 - tau) * t + tau * s, source_params, target_params
+    )

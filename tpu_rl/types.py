@@ -1,0 +1,85 @@
+"""Core data types shared across the framework."""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# The eight per-step fields of a training batch, in canonical order. Mirrors the
+# reference's shared-memory field set (``/root/reference/agents/storage_module/
+# shared_batch.py:19-64`` and ``utils/utils.py:66-76``).
+BATCH_FIELDS = ("obs", "act", "rew", "logits", "log_prob", "is_fir", "hx", "cx")
+
+
+@struct.dataclass
+class Batch:
+    """A training batch of fixed-length trajectory sequences, shaped
+    ``(batch, seq, feat)`` exactly as the reference samples them out of shared
+    memory (``/root/reference/agents/learner.py:197-233``).
+
+    obs      : (B, S, *obs_shape) float32
+    act      : (B, S, A_act) — discrete: (B, S, 1) action index as float
+    rew      : (B, S, 1) pre-scaled reward
+    logits   : (B, S, A) behavior-policy log-softmax logits (zeros for Normal
+               policies, matching ``networks/models.py:46-49``)
+    log_prob : (B, S, A_lp) behavior log-prob (discrete: A_lp=1)
+    is_fir   : (B, S, 1) 1.0 at episode-first steps (incl. splice seams)
+    hx, cx   : (B, S, H) pre-step LSTM states; training uses [:, 0]
+    """
+
+    obs: jax.Array
+    act: jax.Array
+    rew: jax.Array
+    logits: jax.Array
+    log_prob: jax.Array
+    is_fir: jax.Array
+    hx: jax.Array
+    cx: jax.Array
+
+    @property
+    def batch_size(self) -> int:
+        return self.obs.shape[0]
+
+    @property
+    def seq_len(self) -> int:
+        return self.obs.shape[1]
+
+    def astuple(self) -> tuple[jax.Array, ...]:
+        return tuple(getattr(self, k) for k in BATCH_FIELDS)
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "Batch":
+        return cls(**{k: jnp.asarray(m[k]) for k in BATCH_FIELDS})
+
+    @classmethod
+    def zeros(
+        cls,
+        batch: int,
+        seq: int,
+        obs_shape: tuple[int, ...],
+        action_space: int,
+        hidden: int,
+        continuous: bool = False,
+        dtype=jnp.float32,
+    ) -> "Batch":
+        a_act = action_space if continuous else 1
+        a_lp = action_space if continuous else 1
+        z = lambda *sh: jnp.zeros((batch, seq, *sh), dtype)
+        return cls(
+            obs=z(*obs_shape),
+            act=z(a_act),
+            rew=z(1),
+            logits=z(action_space),
+            log_prob=z(a_lp),
+            is_fir=z(1),
+            hx=z(hidden),
+            cx=z(hidden),
+        )
+
+
+def batch_to_numpy(b: Batch) -> dict[str, np.ndarray]:
+    return {k: np.asarray(getattr(b, k)) for k in BATCH_FIELDS}
