@@ -67,8 +67,11 @@ def make_train_state(cfg: Config, family: ModelFamily, key: jax.Array):
             step=jnp.zeros((), jnp.int32),
             actor_params=params["actor"],
             critic_params=params["critic"],
+            # Distinct buffers, not aliases: the reference's target critic IS
+            # the critic object (``agents/learner.py:356-358`` — aliasing bug,
+            # fixed here), and aliased buffers also break jit donation.
             target_critic_params=jax.tree_util.tree_map(
-                lambda x: x, params["critic"]
+                jnp.copy, params["critic"]
             ),
             log_alpha=log_alpha,
             actor_opt=opt_a.init(params["actor"]),
@@ -76,8 +79,13 @@ def make_train_state(cfg: Config, family: ModelFamily, key: jax.Array):
             alpha_opt=opt_al.init(log_alpha),
         )
     if cfg.algo == "V-MPO":
-        init = jnp.log(jnp.asarray(cfg.v_mpo_lagrange_multiplier_init, jnp.float32))
-        params = {**params, "log_eta": init, "log_alpha": init}
+        init = float(jnp.log(jnp.asarray(cfg.v_mpo_lagrange_multiplier_init)))
+        # Two separate buffers (an aliased tree breaks jit donation).
+        params = {
+            **params,
+            "log_eta": jnp.asarray(init, jnp.float32),
+            "log_alpha": jnp.asarray(init, jnp.float32),
+        }
     opt = rmsprop(cfg)
     return TrainState(
         step=jnp.zeros((), jnp.int32), params=params, opt_state=opt.init(params)

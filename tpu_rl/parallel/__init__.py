@@ -1,0 +1,21 @@
+"""Mesh-parallel learner utilities (SURVEY.md §7 step 5)."""
+
+from tpu_rl.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    check_divisible,
+    make_mesh,
+    replicated,
+)
+from tpu_rl.parallel.dp import make_parallel_train_step, replicate, shard_batch
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "check_divisible",
+    "make_mesh",
+    "replicated",
+    "make_parallel_train_step",
+    "replicate",
+    "shard_batch",
+]
