@@ -1,0 +1,96 @@
+"""Learner-FPS benchmark.
+
+Measures steady-state learner throughput in transitions/sec — the reference's
+own headline metric (`learner-throughput` timer, ``/root/reference/agents/
+learner.py:34-36`` + ``utils/utils.py:167-189``: transitions/update =
+seq_len x batch_size = 640, window 100) — for the jitted IMPALA (V-trace) train
+step at the reference's exact batch quantum (batch 128, seq 5, hidden 64,
+CartPole shapes), on whatever accelerator JAX exposes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline for vs_baseline: the reference's maximum sustainable learner ingest,
+bounded by its configured actor fleet = 3 machines x 10 workers x ~20 env
+steps/s (hard 0.05 s sleep, ``agents/worker.py:131``; fleet config
+``utils/machines.json:6-25``) = 600 transitions/sec. The reference publishes
+no measured numbers (BASELINE.md), so its by-construction ceiling is the only
+defensible denominator.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_BASELINE_TPS = 600.0  # see module docstring
+
+
+def make_bench(algo: str = "IMPALA"):
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.config import Config
+    from tpu_rl.parallel import make_mesh, make_parallel_train_step, replicate, shard_batch
+    from tpu_rl.types import Batch
+
+    cfg = Config.from_dict(
+        dict(
+            algo=algo,
+            hidden_size=64,
+            seq_len=5,
+            batch_size=128,
+            obs_shape=(4,),
+            action_space=2,
+        )
+    )
+    family, state, train_step = get_algo(algo).build(cfg, jax.random.key(0))
+    n_dev = len(jax.devices())
+    # Use every visible chip; keep the global batch at the reference quantum.
+    mesh = make_mesh(n_dev if cfg.batch_size % n_dev == 0 else 1)
+    pstep = make_parallel_train_step(train_step, mesh, cfg)
+
+    rng = np.random.default_rng(0)
+    zb = Batch.zeros(
+        cfg.batch_size, cfg.seq_len, cfg.obs_shape, cfg.action_space,
+        cfg.hidden_size, continuous=family.continuous,
+    )
+    batch = zb.replace(
+        obs=jnp.asarray(rng.normal(size=zb.obs.shape).astype(np.float32)),
+        act=jnp.asarray(
+            rng.integers(0, cfg.action_space, size=zb.act.shape).astype(np.float32)
+        ),
+        rew=jnp.asarray(rng.normal(size=zb.rew.shape).astype(np.float32) * 0.1),
+        log_prob=jnp.full(zb.log_prob.shape, -float(np.log(cfg.action_space))),
+    )
+    state = replicate(state, mesh)
+    batch = shard_batch(batch, mesh)
+    key = replicate(jax.random.key(1), mesh)
+    transitions_per_update = cfg.batch_size * cfg.seq_len
+    return pstep, state, batch, key, transitions_per_update
+
+
+def run(warmup: int = 10, iters: int = 200) -> dict:
+    pstep, state, batch, key, tpu_quantum = make_bench()
+    for _ in range(warmup):
+        state, metrics = pstep(state, batch, key)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = pstep(state, batch, key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    tps = iters * tpu_quantum / dt
+    return {
+        "metric": "learner FPS (IMPALA V-trace, batch 128 x seq 5)",
+        "value": round(tps, 1),
+        "unit": "transitions/sec",
+        "vs_baseline": round(tps / REFERENCE_BASELINE_TPS, 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run()))

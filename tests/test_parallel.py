@@ -42,7 +42,9 @@ def _fake_batch(cfg, family, seed=0):
     return b.replace(obs=obs, act=act, rew=noise(b.rew) * 0.1, log_prob=log_prob)
 
 
-@pytest.mark.parametrize("algo", ["PPO", "IMPALA", "V-MPO", "SAC", "SAC-Continuous"])
+@pytest.mark.parametrize(
+    "algo", ["PPO", "PPO-Continuous", "IMPALA", "V-MPO", "SAC", "SAC-Continuous"]
+)
 def test_dp_step_runs_on_8dev_mesh(algo):
     cfg = small_config(algo=algo, batch_size=8)
     family, state, train_step = get_algo(algo).build(cfg, jax.random.key(0))
@@ -86,6 +88,5 @@ def test_dp_matches_single_device():
 def test_batch_not_divisible_raises():
     cfg = small_config(batch_size=6)
     mesh = make_mesh(4)
-    family, state, train_step = get_algo("PPO").build(cfg, jax.random.key(0))
     with pytest.raises(ValueError, match="not divisible"):
-        make_parallel_train_step(train_step, mesh, cfg)
+        make_parallel_train_step(lambda s, b, k: (s, {}), mesh, cfg)
