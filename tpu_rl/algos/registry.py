@@ -14,7 +14,7 @@ import jax
 
 from tpu_rl.algos import impala, ppo, sac, vmpo
 from tpu_rl.algos.base import make_train_state
-from tpu_rl.config import Config
+from tpu_rl.config import Config, is_off_policy
 from tpu_rl.models.families import ALGOS, ModelFamily, build_family
 
 
@@ -41,6 +41,8 @@ _REGISTRY: dict[str, AlgoSpec] = {
 }
 
 assert set(_REGISTRY) == set(ALGOS)
+# The storage-semantics table in config.py must agree with the specs here.
+assert all(spec.on_policy != is_off_policy(name) for name, spec in _REGISTRY.items())
 
 
 def get_algo(name: str) -> AlgoSpec:

@@ -16,6 +16,16 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+# Single source of truth for storage semantics per algorithm (reference
+# switcher ``main.py:310-321``). The algo registry asserts consistency with
+# its specs; kept here so the host-only data plane never imports jax.
+OFF_POLICY_ALGOS = frozenset({"SAC", "SAC-Continuous"})
+
+
+def is_off_policy(algo: str) -> bool:
+    return algo in OFF_POLICY_ALGOS
+
+
 @dataclass
 class Config:
     """Hyperparameters. Field names/defaults match the reference's

@@ -1,0 +1,19 @@
+"""Host-side data plane: trajectory assembly and shared-memory batch stores.
+
+This is the TPU framework's L3 (SURVEY.md §1): the path from per-step worker
+messages to device-ready ``Batch`` arrays. Everything here is host/numpy code —
+the device boundary is crossed exactly once, in ``parallel.dp.shard_batch``.
+"""
+
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.data.assembler import RolloutAssembler, Trajectory
+from tpu_rl.data.shm_ring import OnPolicyStore, ReplayStore, make_store
+
+__all__ = [
+    "BatchLayout",
+    "RolloutAssembler",
+    "Trajectory",
+    "OnPolicyStore",
+    "ReplayStore",
+    "make_store",
+]

@@ -1,0 +1,172 @@
+"""Reassemble interleaved per-step worker messages into fixed-length
+training sequences.
+
+Capability parity with the reference's ``RolloutAssembler``
+(``/root/reference/buffers/rollout_assembler.py:25-83``), re-designed as a
+synchronous, transport-agnostic state machine (the reference couples it to an
+``asyncio.Queue``). Semantics kept:
+
+- steps are keyed by episode id and buffered until ``seq_len`` accumulate,
+  then emitted as a dict of ``(seq, width)`` float32 arrays;
+- in-flight trajectories idle longer than ``lag_sec`` are dropped (policy-lag
+  bound, reference ``rollout_assembler.py:52-56``);
+- an episode that ends short of ``seq_len`` is parked; the next *new* episode
+  splices onto the **shortest** parked remnant, re-marking ``is_fir = 1.0`` at
+  the seam so losses mask the fake time adjacency
+  (reference ``rollout_assembler.py:61-67``).
+
+Divergences (deliberate, documented):
+
+- staleness is measured from the trajectory's **last push**, not its creation
+  time — the reference drops a trajectory 0.5 s after *creation* even while
+  it is actively receiving steps, which on slow workers discards every
+  partially-filled window;
+- parked done-remnants are also aged out by ``lag_sec`` (the reference keeps
+  them forever, so arbitrarily stale steps can be spliced into fresh windows);
+- emitted windows go to a plain deque (``pop`` returns None when empty) so the
+  same object serves sync tests, the storage process loop, and asyncio users.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.types import BATCH_FIELDS
+
+
+@dataclass
+class Trajectory:
+    """Per-episode step accumulator (reference ``Trajectory2``,
+    ``/root/reference/buffers/trajectory.py:20-39``), with a last-activity
+    timestamp instead of a creation timestamp."""
+
+    steps: list[dict] = field(default_factory=list)
+    last_push: float = 0.0
+
+    def put(self, step: dict, now: float) -> None:
+        self.steps.append(step)
+        self.last_push = now
+
+    def __len__(self) -> int:
+        return len(self.steps)
+
+
+def stack_window(steps: list[dict]) -> dict[str, np.ndarray]:
+    """steps (list of per-step field dicts) -> dict of (seq, width) arrays
+    (reference ``make_as_array``, ``rollout_assembler.py:9-22``)."""
+    return {
+        k: np.stack([np.asarray(s[k], np.float32) for s in steps])
+        for k in BATCH_FIELDS
+    }
+
+
+class RolloutAssembler:
+    def __init__(
+        self,
+        layout: BatchLayout,
+        lag_sec: float = 0.5,
+        clock=time.monotonic,
+        validate: bool = False,
+    ):
+        self.layout = layout
+        self.seq_len = layout.seq_len
+        self.lag_sec = lag_sec
+        self.clock = clock
+        self.validate = validate
+        self.active: dict[str, Trajectory] = {}
+        self.parked: dict[str, Trajectory] = {}  # done-episodes short of seq_len
+        self._oldest_push = float("-inf")  # lower bound on min(last_push)
+        self.ready: deque[dict] = deque()
+        # observability counters
+        self.n_steps = 0
+        self.n_windows = 0
+        self.n_dropped_stale = 0
+        self.n_spliced = 0
+
+    # ------------------------------------------------------------------ push
+    def push(self, step: dict) -> int:
+        """Feed one env step ``{**BATCH_FIELDS, "id": str, "done": bool}``.
+        Returns the number of windows newly ready."""
+        eid = step["id"]
+        done = bool(step["done"])
+        now = self.clock()
+        if self.validate:
+            self.layout.validate_step(step)
+
+        self._drop_stale(now)
+
+        tj = self.active.get(eid)
+        if tj is None:
+            tj = self._splice_or_new(step, now)
+            self.active[eid] = tj
+        tj.put(step, now)
+        self.n_steps += 1
+        # Maintain the lower bound on min(last_push) used by _drop_stale.
+        if now < self._oldest_push:
+            self._oldest_push = now
+
+        emitted = 0
+        if len(tj) >= self.seq_len:
+            self.ready.append(stack_window(self.active.pop(eid).steps))
+            self.n_windows += 1
+            emitted = 1
+        elif done:
+            # Episode over, window short: park the remnant for splicing.
+            self.parked[eid] = self.active.pop(eid)
+        return emitted
+
+    def _splice_or_new(self, step: dict, now: float) -> Trajectory:
+        if self.parked:
+            # Splice onto the shortest parked remnant so remnants drain fastest
+            # (reference heappop-by-length, ``rollout_assembler.py:61-65``).
+            eid = min(self.parked, key=lambda k: len(self.parked[k]))
+            tj = self.parked.pop(eid)
+            # The seam is a fake time adjacency: force the episode-first flag
+            # so GAE/V-trace/value bootstraps are masked across it.
+            step["is_fir"] = np.ones_like(np.asarray(step["is_fir"], np.float32))
+            self.n_spliced += 1
+            return tj
+        return Trajectory(last_push=now)
+
+    def _drop_stale(self, now: float) -> None:
+        # Skip the O(episodes) scan until the oldest trajectory could possibly
+        # be stale — keeps the per-push cost O(1) amortized on the hot ingest
+        # path (all workers funnel through this method).
+        if now < self._oldest_push + self.lag_sec:
+            return
+        oldest = float("inf")
+        for table in (self.active, self.parked):
+            stale = []
+            for eid, tj in table.items():
+                if now - tj.last_push >= self.lag_sec:
+                    stale.append(eid)
+                else:
+                    oldest = min(oldest, tj.last_push)
+            for eid in stale:
+                del table[eid]
+            self.n_dropped_stale += len(stale)
+        self._oldest_push = oldest
+
+    # ------------------------------------------------------------------- pop
+    def pop(self) -> dict | None:
+        """Next ready window as a dict of (seq, width) arrays, or None."""
+        return self.ready.popleft() if self.ready else None
+
+    def __len__(self) -> int:
+        return len(self.ready)
+
+    @property
+    def stats(self) -> dict[str, int]:
+        return dict(
+            steps=self.n_steps,
+            windows=self.n_windows,
+            dropped_stale=self.n_dropped_stale,
+            spliced=self.n_spliced,
+            active=len(self.active),
+            parked=len(self.parked),
+        )

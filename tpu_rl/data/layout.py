@@ -1,0 +1,83 @@
+"""Canonical per-step field layout of a trajectory batch.
+
+One place that knows the feature width of every ``BATCH_FIELDS`` entry, derived
+from the config. The reference re-derives these shapes ad hoc at every layer
+(``/root/reference/agents/storage_module/shared_batch.py:19-64`` allocation,
+``agents/learner_storage.py:123-159`` writes, ``agents/learner.py:197-233``
+reads); here the layout is computed once and shared by the assembler, the
+shared-memory stores, and the learner sampler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_rl.config import Config
+from tpu_rl.types import BATCH_FIELDS
+
+
+@dataclass(frozen=True)
+class BatchLayout:
+    """Feature width per field for one (obs/action-space, algo) combination.
+
+    All fields are float32 and shaped ``(seq, width)`` per trajectory —
+    including discrete actions, stored as a float index in a width-1 column
+    (reference convention, ``shared_batch.py:28-31``).
+    """
+
+    obs: int
+    act: int
+    rew: int
+    logits: int
+    log_prob: int
+    is_fir: int
+    hx: int
+    cx: int
+    seq_len: int
+
+    @classmethod
+    def from_config(cls, cfg: Config) -> "BatchLayout":
+        obs = int(np.prod(cfg.obs_shape))
+        n = int(cfg.action_space)
+        wide = n if cfg.is_continuous else 1
+        return cls(
+            obs=obs,
+            act=wide,
+            rew=1,
+            logits=n,
+            log_prob=wide,
+            is_fir=1,
+            hx=cfg.hidden_size,
+            cx=cfg.hidden_size,
+            seq_len=cfg.seq_len,
+        )
+
+    def width(self, field: str) -> int:
+        return getattr(self, field)
+
+    @property
+    def fields(self) -> tuple[str, ...]:
+        return BATCH_FIELDS
+
+    @property
+    def step_floats(self) -> int:
+        """Total float32 count of one env step across all fields."""
+        return sum(self.width(f) for f in BATCH_FIELDS)
+
+    @property
+    def traj_floats(self) -> int:
+        """Total float32 count of one seq_len trajectory across all fields."""
+        return self.seq_len * self.step_floats
+
+    def validate_step(self, step: dict) -> None:
+        """Assert a worker step dict matches this layout (shape errors fail
+        here, at the producer, instead of corrupting the shm ring)."""
+        for f in BATCH_FIELDS:
+            arr = np.asarray(step[f])
+            if arr.shape != (self.width(f),):
+                raise ValueError(
+                    f"step field {f!r}: expected shape ({self.width(f)},), "
+                    f"got {arr.shape}"
+                )

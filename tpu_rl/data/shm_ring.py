@@ -1,0 +1,209 @@
+"""Shared-memory trajectory stores shared by the storage and learner processes.
+
+Capability parity with the reference's flat ``mp.Array`` blocks + ``sh_data_num``
+counter (``/root/reference/agents/storage_module/shared_batch.py:19-107``),
+re-designed around the two access patterns it conflates:
+
+- **OnPolicyStore** (capacity = ``batch_size``, reference
+  ``reset_shared_on_policy_memory``): single-writer fill, consume-all-and-reset
+  reader. The reference's reader resets the counter while the writer may be
+  mid-write (benign race, SURVEY.md §5.2); here the writer validates a
+  generation counter after finishing its slot write and re-writes into the new
+  generation if a consume intervened, so a consumed batch never contains a
+  torn or misplaced trajectory.
+- **ReplayStore** (capacity = ``buffer_size``, reference
+  ``reset_shared_buffer_memory``): ring overwrite + uniform sampling. The
+  reference samples slots that are concurrently being overwritten
+  (``agents/learner.py:168-195``); here each slot carries a seqlock version
+  (even = stable, odd = write in progress) and the sampler retries torn reads.
+
+Data lives in one ``mp.Array("f")`` per field, viewed as
+``(capacity, seq_len, width)`` numpy arrays — same memory layout as the
+reference's flat blocks, so the driver-visible capability (zero-copy IPC of
+assembled trajectories) is identical.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from dataclasses import dataclass
+
+import numpy as np
+
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.types import BATCH_FIELDS
+
+
+@dataclass
+class ShmHandles:
+    """Raw multiprocessing primitives; picklable into child processes via
+    ``mp.Process`` args (the reference's ``shm_ref`` dict,
+    ``shared_batch.py:19-64``)."""
+
+    arrays: dict  # field -> mp.Array("f", capacity * seq * width)
+    versions: mp.Array  # per-slot seqlock counters ("L", capacity)
+    count: mp.Value  # OnPolicy: filled slots this generation; Replay: total puts
+    gen: mp.Value  # OnPolicy consume generation
+    lock: mp.Lock
+    capacity: int
+
+
+def alloc_handles(
+    layout: BatchLayout, capacity: int, ctx=None
+) -> ShmHandles:
+    """Allocate from an explicit mp context — default spawn, matching the
+    runner's start method (reference ``main.py:64``); fork-context primitives
+    cannot be passed into spawn children."""
+    ctx = ctx or mp.get_context("spawn")
+    arrays = {
+        f: ctx.Array("f", capacity * layout.seq_len * layout.width(f), lock=False)
+        for f in BATCH_FIELDS
+    }
+    return ShmHandles(
+        arrays=arrays,
+        versions=ctx.Array("L", capacity, lock=False),
+        count=ctx.Value("q", 0, lock=False),
+        gen=ctx.Value("q", 0, lock=False),
+        lock=ctx.Lock(),
+        capacity=capacity,
+    )
+
+
+class _StoreBase:
+    """Numpy views over the handles; construct one per process (views bind to
+    the inherited shared buffers, reference ``SMInterFace``,
+    ``shared_batch.py:75-107``)."""
+
+    def __init__(self, handles: ShmHandles, layout: BatchLayout):
+        self.h = handles
+        self.layout = layout
+        self.capacity = handles.capacity
+        self.views = {
+            f: np.frombuffer(handles.arrays[f], dtype=np.float32).reshape(
+                handles.capacity, layout.seq_len, layout.width(f)
+            )
+            for f in BATCH_FIELDS
+        }
+        self.versions = np.frombuffer(handles.versions, dtype=np.uint64)
+
+    def _write_slot(self, slot: int, window: dict) -> None:
+        for f in BATCH_FIELDS:
+            self.views[f][slot] = window[f]
+
+    def _read_slots(self, idx: np.ndarray | slice) -> dict[str, np.ndarray]:
+        return {f: self.views[f][idx].copy() for f in BATCH_FIELDS}
+
+
+class OnPolicyStore(_StoreBase):
+    """Fill-then-consume batch store (single writer, single reader)."""
+
+    # ---------------------------------------------------------------- writer
+    def put(self, window: dict) -> bool:
+        """Write one (seq, width)-per-field trajectory window. Returns False
+        when the current generation is full (caller drops or retries later,
+        matching the reference's ``num < mem_size`` guard,
+        ``learner_storage.py:139``)."""
+        h = self.h
+        while True:
+            with h.lock:
+                gen, slot = h.gen.value, h.count.value
+                if slot >= self.capacity:
+                    return False
+            self._write_slot(slot, window)
+            with h.lock:
+                if h.gen.value == gen:
+                    # No consume intervened: publish the slot.
+                    h.count.value = slot + 1
+                    return True
+            # A consume reset the store mid-write; re-write into the new
+            # generation (this is the race the reference ignores).
+
+    # ---------------------------------------------------------------- reader
+    @property
+    def size(self) -> int:
+        with self.h.lock:
+            return self.h.count.value
+
+    def consume(self, need: int | None = None) -> dict[str, np.ndarray] | None:
+        """If at least ``need`` (default: capacity) trajectories are ready,
+        copy them out, reset the store, and return ``field -> (n, seq, width)``
+        arrays; else None (reference gate ``sh_data_num >= batch_size`` +
+        ``reset_data_num``, ``agents/learner.py:250-262``)."""
+        need = self.capacity if need is None else need
+        h = self.h
+        with h.lock:
+            n = h.count.value
+            if n < need:
+                return None
+            out = self._read_slots(slice(0, n))
+            h.gen.value += 1
+            h.count.value = 0
+        return out
+
+
+class ReplayStore(_StoreBase):
+    """Overwriting ring + uniform sampler (SAC replay). Single writer, any
+    number of sampling readers."""
+
+    # ---------------------------------------------------------------- writer
+    def put(self, window: dict) -> bool:
+        h = self.h
+        with h.lock:
+            total = h.count.value
+        slot = total % self.capacity
+        self.versions[slot] += 1  # odd: write in progress
+        self._write_slot(slot, window)
+        self.versions[slot] += 1  # even: stable
+        with h.lock:
+            h.count.value = total + 1
+        return True
+
+    # ---------------------------------------------------------------- reader
+    @property
+    def size(self) -> int:
+        with self.h.lock:
+            return min(self.h.count.value, self.capacity)
+
+    def sample(
+        self, batch: int, rng: np.random.Generator, max_retries: int = 8
+    ) -> dict[str, np.ndarray] | None:
+        """Uniform sample of ``batch`` trajectories; None until the ring holds
+        at least ``batch`` (the reference latches "start once full",
+        ``agents/learner.py:369-389`` — we only require >= batch). Torn slots
+        (overwritten mid-read) are re-drawn via the seqlock."""
+        n = self.size
+        if n < batch:
+            return None
+        idx = rng.integers(0, n, size=batch)
+        out = {
+            f: np.empty(
+                (batch, self.layout.seq_len, self.layout.width(f)), np.float32
+            )
+            for f in BATCH_FIELDS
+        }
+        for i, slot in enumerate(idx):
+            for _ in range(max_retries):
+                v1 = int(self.versions[slot])
+                if v1 % 2 == 0:
+                    for f in BATCH_FIELDS:
+                        out[f][i] = self.views[f][slot]
+                    if int(self.versions[slot]) == v1:
+                        break
+                slot = int(rng.integers(0, n))  # torn: re-draw
+            else:
+                for f in BATCH_FIELDS:  # give up racing: accept best effort
+                    out[f][i] = self.views[f][slot]
+        return out
+
+
+def make_store(cfg, layout: BatchLayout, handles: ShmHandles | None = None):
+    """Store factory keyed on the algo's on/off-policy nature (reference
+    switcher ``main.py:310-321``). Pass ``handles`` in child processes."""
+    from tpu_rl.config import is_off_policy
+
+    off_policy = is_off_policy(cfg.algo)
+    capacity = cfg.buffer_size if off_policy else cfg.batch_size
+    if handles is None:
+        handles = alloc_handles(layout, capacity)
+    cls = ReplayStore if off_policy else OnPolicyStore
+    return cls(handles, layout)
