@@ -1,0 +1,206 @@
+// tpu_rl native wire codec: LZ4 block-format compressor/decompressor.
+//
+// The reference's wire path is pickle + blosc2 (c-blosc2, clevel=1) —
+// its only native data-plane component (/root/reference/utils/utils.py:244-249).
+// This is the TPU framework's equivalent: a clean-room implementation of the
+// public LZ4 block format (token / literals / 16-bit offset / match, as
+// documented in the LZ4 spec), tuned like clevel=1: greedy single-probe hash
+// matching, favoring speed over ratio. Built with `g++ -O3 -shared -fPIC`
+// (see tpu_rl/runtime/native.py) and called through ctypes, which releases
+// the GIL for the duration — compression runs concurrently with the Python
+// event loop.
+//
+// Exported C ABI:
+//   int64 tpurl_compress_bound(int64 n)                       -> worst-case dst size
+//   int64 tpurl_compress(src, n, dst, cap)                    -> bytes written, <0 on error
+//   int64 tpurl_decompress(src, n, dst, cap)                  -> bytes written, <0 on error
+//   uint32 tpurl_crc32(src, n, seed)                          -> checksum (frame integrity)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kHashLog = 16;
+constexpr int kMinMatch = 4;
+// Format guarantees: the last 5 bytes are always literals, and the last match
+// must end at least 12 bytes before the block end.
+constexpr int kLastLiterals = 5;
+constexpr int kMfLimit = 12;
+constexpr uint32_t kMaxOffset = 65535;
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> (32 - kHashLog);
+}
+
+// Write a length with 15-in-nibble + 255-byte continuation encoding.
+inline uint8_t* write_length(uint8_t* op, size_t len) {
+  while (len >= 255) {
+    *op++ = 255;
+    len -= 255;
+  }
+  *op++ = static_cast<uint8_t>(len);
+  return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t tpurl_compress_bound(int64_t n) {
+  if (n < 0) return -1;
+  // LZ4_compressBound formula: worst case is all-literals plus continuation bytes.
+  return n + n / 255 + 16;
+}
+
+int64_t tpurl_compress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                       int64_t dst_cap) {
+  if (src_len < 0 || dst_cap < tpurl_compress_bound(src_len)) return -1;
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + src_len;
+  const uint8_t* anchor = src;  // start of pending literals
+  uint8_t* op = dst;
+
+  if (src_len >= kMfLimit) {
+    const uint8_t* const match_limit = iend - kMfLimit;
+    uint32_t table[1 << kHashLog];
+    std::memset(table, 0, sizeof(table));
+    // Positions stored +1 so 0 means empty.
+    table[hash4(read32(ip))] = static_cast<uint32_t>(ip - src) + 1;
+    ++ip;
+
+    while (ip <= match_limit) {
+      const uint32_t seq = read32(ip);
+      const uint32_t h = hash4(seq);
+      const uint32_t cand = table[h];
+      table[h] = static_cast<uint32_t>(ip - src) + 1;
+      const uint8_t* match = cand ? src + cand - 1 : nullptr;
+      if (!match || static_cast<uint32_t>(ip - match) > kMaxOffset ||
+          read32(match) != seq) {
+        ++ip;
+        continue;
+      }
+      // Extend the match forward (stop kLastLiterals before the end).
+      const uint8_t* const mend_limit = iend - kLastLiterals;
+      const uint8_t* mip = ip + kMinMatch;
+      const uint8_t* mmatch = match + kMinMatch;
+      while (mip < mend_limit && *mip == *mmatch) {
+        ++mip;
+        ++mmatch;
+      }
+      const size_t match_len = static_cast<size_t>(mip - ip) - kMinMatch;
+      const size_t lit_len = static_cast<size_t>(ip - anchor);
+
+      // Token.
+      uint8_t* const token = op++;
+      *token = 0;
+      if (lit_len >= 15) {
+        *token = 15 << 4;
+        op = write_length(op, lit_len - 15);
+      } else {
+        *token = static_cast<uint8_t>(lit_len << 4);
+      }
+      std::memcpy(op, anchor, lit_len);
+      op += lit_len;
+
+      const uint16_t offset = static_cast<uint16_t>(ip - match);
+      std::memcpy(op, &offset, 2);
+      op += 2;
+      if (match_len >= 15) {
+        *token |= 15;
+        op = write_length(op, match_len - 15);
+      } else {
+        *token |= static_cast<uint8_t>(match_len);
+      }
+
+      ip = mip;
+      anchor = ip;
+      if (ip <= match_limit) {
+        table[hash4(read32(ip - 2))] = static_cast<uint32_t>(ip - 2 - src) + 1;
+      }
+    }
+  }
+
+  // Trailing literals.
+  const size_t lit_len = static_cast<size_t>(iend - anchor);
+  uint8_t* const token = op++;
+  if (lit_len >= 15) {
+    *token = 15 << 4;
+    op = write_length(op, lit_len - 15);
+  } else {
+    *token = static_cast<uint8_t>(lit_len << 4);
+  }
+  std::memcpy(op, anchor, lit_len);
+  op += lit_len;
+  return op - dst;
+}
+
+int64_t tpurl_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                         int64_t dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + src_len;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+  if (src_len <= 0) return src_len == 0 ? 0 : -1;
+
+  while (ip < iend) {
+    const uint8_t token = *ip++;
+    // Literals.
+    size_t lit_len = token >> 4;
+    if (lit_len == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -2;
+        b = *ip++;
+        lit_len += b;
+      } while (b == 255);
+    }
+    if (ip + lit_len > iend || op + lit_len > oend) return -2;
+    std::memcpy(op, ip, lit_len);
+    ip += lit_len;
+    op += lit_len;
+    if (ip >= iend) break;  // last sequence carries no match
+
+    // Match.
+    if (ip + 2 > iend) return -2;
+    uint16_t offset;
+    std::memcpy(&offset, ip, 2);
+    ip += 2;
+    if (offset == 0 || offset > op - dst) return -3;  // corrupt offset
+    size_t match_len = token & 15;
+    if (match_len == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -2;
+        b = *ip++;
+        match_len += b;
+      } while (b == 255);
+    }
+    match_len += kMinMatch;
+    if (op + match_len > oend) return -2;
+    // Overlapping copy must be byte-wise (offset may be < match_len).
+    const uint8_t* match = op - offset;
+    for (size_t i = 0; i < match_len; ++i) op[i] = match[i];
+    op += match_len;
+  }
+  return op - dst;
+}
+
+uint32_t tpurl_crc32(const uint8_t* src, int64_t n, uint32_t seed) {
+  // Standard CRC-32 (IEEE 802.3), bitwise-free table-less slice-by-1 with the
+  // reflected polynomial; fast enough for frame headers and small payloads.
+  uint32_t crc = ~seed;
+  for (int64_t i = 0; i < n; ++i) {
+    crc ^= src[i];
+    for (int k = 0; k < 8; ++k) crc = (crc >> 1) ^ (0xEDB88320u & (~(crc & 1) + 1));
+  }
+  return ~crc;
+}
+
+}  // extern "C"

@@ -39,20 +39,15 @@ class BatchLayout:
 
     @classmethod
     def from_config(cls, cfg: Config) -> "BatchLayout":
-        obs = int(np.prod(cfg.obs_shape))
-        n = int(cfg.action_space)
-        wide = n if cfg.is_continuous else 1
-        return cls(
-            obs=obs,
-            act=wide,
-            rew=1,
-            logits=n,
-            log_prob=wide,
-            is_fir=1,
-            hx=cfg.hidden_size,
-            cx=cfg.hidden_size,
-            seq_len=cfg.seq_len,
+        from tpu_rl.types import field_widths
+
+        widths = field_widths(
+            int(np.prod(cfg.obs_shape)),
+            int(cfg.action_space),
+            cfg.hidden_size,
+            cfg.is_continuous,
         )
+        return cls(seq_len=cfg.seq_len, **widths)
 
     def width(self, field: str) -> int:
         return getattr(self, field)

@@ -170,7 +170,10 @@ class ReplayStore(_StoreBase):
         """Uniform sample of ``batch`` trajectories; None until the ring holds
         at least ``batch`` (the reference latches "start once full",
         ``agents/learner.py:369-389`` — we only require >= batch). Torn slots
-        (overwritten mid-read) are re-drawn via the seqlock."""
+        (overwritten mid-read) are re-drawn via the seqlock; if a consistent
+        sample cannot be assembled within the retry budget, returns None
+        (callers treat it as "not ready") — a torn trajectory is NEVER
+        returned, unlike the reference sampler (``agents/learner.py:168-195``)."""
         n = self.size
         if n < batch:
             return None
@@ -191,8 +194,7 @@ class ReplayStore(_StoreBase):
                         break
                 slot = int(rng.integers(0, n))  # torn: re-draw
             else:
-                for f in BATCH_FIELDS:  # give up racing: accept best effort
-                    out[f][i] = self.views[f][slot]
+                return None  # retry budget exhausted; sample again later
         return out
 
 

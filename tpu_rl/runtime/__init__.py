@@ -1,0 +1,2 @@
+"""Distributed runtime: transport, protocol, and the role processes
+(worker / manager / storage / learner) — SURVEY.md §1 layers L2 and L6."""

@@ -1,0 +1,105 @@
+"""Loader for the native C++ codec (``native/codec.cpp``).
+
+Builds the shared library on demand with ``g++ -O3`` (cached next to the
+source), binds it through ctypes — which releases the GIL for the duration of
+each call, so compression overlaps the Python event loop — and exposes
+``compress``/``decompress``/``crc32``. When no toolchain is available the
+module still imports and ``LIB`` is None; the protocol layer falls back to
+zlib (the reference's equivalent native dep is c-blosc2,
+``/root/reference/utils/utils.py:244-249``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SRC = os.path.join(_REPO, "native", "codec.cpp")
+SO = os.path.join(_REPO, "native", "build", "libtpurl_codec.so")
+
+_lock = threading.Lock()
+
+
+def _build() -> str | None:
+    if not os.path.exists(SRC):
+        return None
+    if os.path.exists(SO) and os.path.getmtime(SO) >= os.path.getmtime(SRC):
+        return SO
+    os.makedirs(os.path.dirname(SO), exist_ok=True)
+    # Atomic build: compile to a temp name, rename into place (concurrent
+    # role processes may race to build at first launch).
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(SO))
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, SO)
+        return SO
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> ctypes.CDLL | None:
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    i64, u32, buf = ctypes.c_int64, ctypes.c_uint32, ctypes.c_char_p
+    lib.tpurl_compress_bound.restype = i64
+    lib.tpurl_compress_bound.argtypes = [i64]
+    lib.tpurl_compress.restype = i64
+    lib.tpurl_compress.argtypes = [buf, i64, ctypes.c_void_p, i64]
+    lib.tpurl_decompress.restype = i64
+    lib.tpurl_decompress.argtypes = [buf, i64, ctypes.c_void_p, i64]
+    lib.tpurl_crc32.restype = u32
+    lib.tpurl_crc32.argtypes = [buf, i64, u32]
+    return lib
+
+
+with _lock:
+    LIB = _load()
+
+
+def available() -> bool:
+    return LIB is not None
+
+
+def compress(data: bytes) -> bytes:
+    assert LIB is not None
+    bound = LIB.tpurl_compress_bound(len(data))
+    out = ctypes.create_string_buffer(bound)
+    n = LIB.tpurl_compress(data, len(data), out, bound)
+    if n < 0:
+        raise RuntimeError(f"native compress failed: {n}")
+    return out.raw[:n]
+
+
+def decompress(data: bytes, raw_size: int) -> bytes:
+    assert LIB is not None
+    out = ctypes.create_string_buffer(raw_size) if raw_size else b""
+    if raw_size == 0:
+        return b""
+    n = LIB.tpurl_decompress(data, len(data), out, raw_size)
+    if n != raw_size:
+        raise RuntimeError(f"native decompress failed: {n} != {raw_size}")
+    return out.raw[:n]
+
+
+def crc32(data: bytes, seed: int = 0) -> int:
+    assert LIB is not None
+    return int(LIB.tpurl_crc32(data, len(data), seed))

@@ -1,0 +1,151 @@
+"""Wire protocol: message kinds + framed codec.
+
+Capability parity with the reference's 3-symbol protocol and
+pickle+blosc2 codec (``/root/reference/utils/utils.py:229-249``), upgraded:
+
+- the protocol symbol travels as a single byte, not a pickled enum;
+- payload frames carry a header (magic, codec id, raw size, crc32 of the
+  compressed body) so a corrupt or foreign frame is rejected instead of
+  unpickled — PUB/SUB is best-effort and the reference feeds whatever arrives
+  straight into ``pickle.loads``;
+- compression is the native C++ LZ4-block codec (``native/codec.cpp``) with a
+  zlib fallback, chosen per-process at import; both ends interoperate because
+  the codec id is in the header;
+- tiny payloads skip compression (codec=raw) — the reference pays blosc on
+  every 2-float stat message.
+"""
+
+from __future__ import annotations
+
+import enum
+import pickle
+import struct
+import zlib
+from typing import Any
+
+from tpu_rl.runtime import native
+
+
+def _lz4_decompress_py(src: bytes, raw_size: int) -> bytes:
+    """Pure-Python LZ4 block decoder — fallback mirror of
+    ``native/codec.cpp:tpurl_decompress`` for hosts without a C++ toolchain."""
+    out = bytearray()
+    i, n = 0, len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        lit_len = token >> 4
+        if lit_len == 15:
+            while True:
+                if i >= n:
+                    raise ValueError("truncated LZ4 literal length")
+                b = src[i]
+                i += 1
+                lit_len += b
+                if b != 255:
+                    break
+        if i + lit_len > n:
+            raise ValueError("truncated LZ4 literals")
+        out += src[i : i + lit_len]
+        i += lit_len
+        if i >= n:
+            break  # last sequence has no match
+        if i + 2 > n:
+            raise ValueError("truncated LZ4 offset")
+        offset = src[i] | (src[i + 1] << 8)
+        i += 2
+        if offset == 0 or offset > len(out):
+            raise ValueError("corrupt LZ4 offset")
+        match_len = token & 15
+        if match_len == 15:
+            while True:
+                if i >= n:
+                    raise ValueError("truncated LZ4 match length")
+                b = src[i]
+                i += 1
+                match_len += b
+                if b != 255:
+                    break
+        match_len += 4
+        # Overlapping copy must be byte-serial when offset < match_len.
+        pos = len(out) - offset
+        for _ in range(match_len):
+            out.append(out[pos])
+            pos += 1
+    if len(out) != raw_size:
+        raise ValueError(f"LZ4 size mismatch: {len(out)} != {raw_size}")
+    return bytes(out)
+
+
+class Protocol(enum.IntEnum):
+    """Message kinds (reference ``utils/utils.py:229-232``)."""
+
+    Model = 0  # learner -> workers: parameter broadcast
+    Rollout = 1  # worker -> manager -> storage: one env step
+    Stat = 2  # worker -> manager -> storage: episode reward
+
+
+class Codec(enum.IntEnum):
+    RAW = 0
+    LZ4 = 1  # native/codec.cpp
+    ZLIB = 2
+
+
+_MAGIC = 0x5452  # "TR"
+_HEADER = struct.Struct("<HBBII")  # magic, version, codec, raw_size, crc32
+_VERSION = 1
+_MIN_COMPRESS = 128  # bytes; below this, framing overhead beats compression
+
+# Standard IEEE CRC-32 (zlib's C implementation; interoperates with the
+# native tpurl_crc32, which implements the same polynomial).
+_crc = zlib.crc32
+
+
+def encode(proto: Protocol, payload: Any) -> list[bytes]:
+    """-> 2-part multipart message ``[proto_byte, frame]`` (reference
+    ``encode``, ``utils/utils.py:244-245``)."""
+    raw = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    if len(raw) < _MIN_COMPRESS:
+        codec, body = Codec.RAW, raw
+    elif native.available():
+        codec, body = Codec.LZ4, native.compress(raw)
+    else:
+        codec, body = Codec.ZLIB, zlib.compress(raw, level=1)
+    if codec != Codec.RAW and len(body) >= len(raw):
+        codec, body = Codec.RAW, raw  # incompressible: ship raw
+    header = _HEADER.pack(_MAGIC, _VERSION, codec, len(raw), _crc(body) & 0xFFFFFFFF)
+    return [bytes([proto]), header + body]
+
+
+def decode(parts: list[bytes]) -> tuple[Protocol, Any]:
+    """Inverse of :func:`encode` (reference ``decode``,
+    ``utils/utils.py:248-249``). Raises ValueError on malformed frames."""
+    if len(parts) != 2 or len(parts[0]) != 1:
+        raise ValueError(f"malformed multipart message: {len(parts)} parts")
+    proto = Protocol(parts[0][0])
+    frame = parts[1]
+    if len(frame) < _HEADER.size:
+        raise ValueError("short frame")
+    magic, version, codec, raw_size, crc = _HEADER.unpack_from(frame)
+    if magic != _MAGIC or version != _VERSION:
+        raise ValueError(f"bad frame magic/version {magic:#x}/{version}")
+    body = frame[_HEADER.size :]
+    if _crc(body) & 0xFFFFFFFF != crc:
+        raise ValueError("frame crc mismatch")
+    if codec == Codec.RAW:
+        raw = body
+    elif codec == Codec.LZ4:
+        if native.available():
+            raw = native.decompress(body, raw_size)
+        else:
+            # Peer has the native codec, this host does not (no toolchain):
+            # decode in Python so interop is bidirectional. Slow, but only
+            # ever hit on degraded hosts.
+            raw = _lz4_decompress_py(body, raw_size)
+    elif codec == Codec.ZLIB:
+        raw = zlib.decompress(body)
+    else:
+        raise ValueError(f"unknown codec {codec}")
+    if len(raw) != raw_size:
+        raise ValueError(f"size mismatch: {len(raw)} != {raw_size}")
+    return proto, pickle.loads(raw)

@@ -1,0 +1,124 @@
+"""ZeroMQ PUB/SUB transport wrappers.
+
+Capability parity with the per-role raw socket setup scattered through the
+reference (``/root/reference/agents/worker.py:45-56``,
+``agents/manager.py:30-40``, ``agents/learner_storage.py:60-66``,
+``agents/learner.py:85-90``), centralized: every channel is a PUB or SUB
+endpoint created from one factory, always carrying :mod:`protocol` frames.
+PUB/SUB is deliberate — best-effort, lossy, connection-free — because the
+algorithms absorb drops (off-policy corrections) and workers must be able to
+join/leave freely (SURVEY.md §5.3).
+
+The DCN topology (SURVEY.md §1 "physical process topology"):
+
+- rollout/stat channel: worker PUB -> manager SUB (bind) -> manager PUB ->
+  storage SUB (bind);
+- model channel: learner PUB (bind) -> every worker SUB, on ``model_port =
+  learner_port + 1`` — the broadcast bypasses managers.
+
+On TPU pods this remains the host-side fabric; chip-to-chip traffic rides ICI
+via XLA collectives instead (``tpu_rl.parallel``), which the reference has no
+equivalent of.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+import zmq
+import zmq.asyncio
+
+from tpu_rl.runtime.protocol import Protocol, decode, encode
+
+# Keep only the newest model broadcast in flight (a worker that lags wants the
+# freshest params, not a backlog); rollout channels buffer more.
+MODEL_HWM = 4
+DATA_HWM = 4096
+
+
+def _endpoint(ip: str, port: int) -> str:
+    return f"tcp://{ip}:{port}"
+
+
+class Pub:
+    """Synchronous PUB endpoint (the learner's model broadcast is sync in the
+    reference too, ``agents/learner.py:85-90``)."""
+
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.PUB)
+        self.sock.set_hwm(hwm)
+        ep = _endpoint(ip, port)
+        self.sock.bind(ep) if bind else self.sock.connect(ep)
+
+    def send(self, proto: Protocol, payload: Any) -> None:
+        self.sock.send_multipart(encode(proto, payload))
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class Sub:
+    """Synchronous SUB endpoint subscribed to everything."""
+
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.SUB)
+        self.sock.set_hwm(hwm)
+        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        ep = _endpoint(ip, port)
+        self.sock.bind(ep) if bind else self.sock.connect(ep)
+
+    def recv(self, timeout_ms: int | None = None) -> tuple[Protocol, Any] | None:
+        """Blocking (or timed) receive of one decoded message; None on
+        timeout."""
+        if timeout_ms is not None:
+            if not self.sock.poll(timeout_ms):
+                return None
+        return decode(self.sock.recv_multipart())
+
+    def drain(self, max_msgs: int = 1024) -> Iterator[tuple[Protocol, Any]]:
+        """Yield every message currently queued, newest-bounded."""
+        for _ in range(max_msgs):
+            try:
+                parts = self.sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            yield decode(parts)
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class AsyncSub:
+    """asyncio SUB endpoint (storage/manager event loops, reference
+    ``zmq.asyncio`` usage)."""
+
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+        self._ctx = ctx or zmq.asyncio.Context.instance()
+        self.sock = self._ctx.socket(zmq.SUB)
+        self.sock.set_hwm(hwm)
+        self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        ep = _endpoint(ip, port)
+        self.sock.bind(ep) if bind else self.sock.connect(ep)
+
+    async def recv(self) -> tuple[Protocol, Any]:
+        return decode(await self.sock.recv_multipart())
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class AsyncPub:
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+        self._ctx = ctx or zmq.asyncio.Context.instance()
+        self.sock = self._ctx.socket(zmq.PUB)
+        self.sock.set_hwm(hwm)
+        ep = _endpoint(ip, port)
+        self.sock.bind(ep) if bind else self.sock.connect(ep)
+
+    async def send(self, proto: Protocol, payload: Any) -> None:
+        await self.sock.send_multipart(encode(proto, payload))
+
+    def close(self) -> None:
+        self.sock.close(linger=0)

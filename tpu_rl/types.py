@@ -15,6 +15,27 @@ from flax import struct
 BATCH_FIELDS = ("obs", "act", "rew", "logits", "log_prob", "is_fir", "hx", "cx")
 
 
+def field_widths(
+    obs_dim: int, action_space: int, hidden: int, continuous: bool
+) -> dict[str, int]:
+    """Canonical feature width of every batch field — THE single source of
+    truth shared by host buffers (``data.layout.BatchLayout``) and device
+    shapes (``Batch.zeros``). Discrete actions/log-probs are width-1 float
+    columns (reference convention,
+    ``/root/reference/agents/storage_module/shared_batch.py:28-31``)."""
+    wide = action_space if continuous else 1
+    return dict(
+        obs=obs_dim,
+        act=wide,
+        rew=1,
+        logits=action_space,
+        log_prob=wide,
+        is_fir=1,
+        hx=hidden,
+        cx=hidden,
+    )
+
+
 @struct.dataclass
 class Batch:
     """A training batch of fixed-length trajectory sequences, shaped
@@ -66,18 +87,19 @@ class Batch:
         continuous: bool = False,
         dtype=jnp.float32,
     ) -> "Batch":
-        a_act = action_space if continuous else 1
-        a_lp = action_space if continuous else 1
+        import numpy as _np
+
+        widths = field_widths(
+            int(_np.prod(obs_shape)), action_space, hidden, continuous
+        )
         z = lambda *sh: jnp.zeros((batch, seq, *sh), dtype)
         return cls(
             obs=z(*obs_shape),
-            act=z(a_act),
-            rew=z(1),
-            logits=z(action_space),
-            log_prob=z(a_lp),
-            is_fir=z(1),
-            hx=z(hidden),
-            cx=z(hidden),
+            **{
+                f: z(widths[f])
+                for f in BATCH_FIELDS
+                if f != "obs"
+            },
         )
 
 
