@@ -1,0 +1,115 @@
+"""CLI: ``python -m tpu_rl <role> [options]``.
+
+Replaces the reference's argv dispatch (``/root/reference/main.py:475-529``)
+with argparse. Roles mirror the reference's ``*_sub_process`` entry points
+plus ``local`` (whole cluster on one host — the smallest real deployment).
+
+Examples:
+    python -m tpu_rl local --env CartPole-v1 --algo PPO
+    python -m tpu_rl learner --params params.json --machines machines.json
+    python -m tpu_rl manager --machines machines.json --machine-idx 0
+    python -m tpu_rl worker  --machines machines.json --machine-idx 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_rl.config import Config, MachinesConfig, default_result_dirs
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="tpu_rl")
+    p.add_argument(
+        "role", choices=["local", "learner", "manager", "worker"],
+        help="which role this host runs",
+    )
+    p.add_argument("--params", help="parameters.json-shaped config file")
+    p.add_argument("--machines", help="machines.json-shaped topology file")
+    p.add_argument("--machine-idx", type=int, default=0,
+                   help="index into machines.workers for manager/worker roles")
+    p.add_argument("--env", help="override env id")
+    p.add_argument("--algo", help="override algorithm")
+    p.add_argument("--mesh-data", type=int, help="learner data-mesh size")
+    p.add_argument("--max-updates", type=int, default=None)
+    p.add_argument("--publish-interval", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-result-dir", action="store_true",
+                   help="disable tensorboard/checkpoint output")
+    return p
+
+
+def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
+    cfg = Config.from_json(args.params) if args.params else Config()
+    overrides = {}
+    if args.env:
+        overrides["env"] = args.env
+    if args.algo:
+        overrides["algo"] = args.algo
+    if args.mesh_data:
+        overrides["mesh_data"] = args.mesh_data
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    machines = (
+        MachinesConfig.from_json(args.machines)
+        if args.machines
+        else MachinesConfig()
+    )
+    return cfg, machines
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg, machines = load_config(args)
+
+    # Probe env spaces once, in the parent (reference ``main.py:82-95``).
+    from tpu_rl.runtime.env import probe_spaces
+
+    cfg = probe_spaces(cfg)
+    if not args.no_result_dir and (
+        cfg.result_dir is None or cfg.model_dir is None
+    ):
+        result_dir, model_dir = default_result_dirs()
+        # Fill only the unset dirs — a user-configured model_dir (checkpoint
+        # resume target) must never be clobbered by the timestamped default.
+        cfg = cfg.replace(
+            result_dir=cfg.result_dir or result_dir,
+            model_dir=cfg.model_dir or model_dir,
+        )
+
+    from tpu_rl.runtime import runner
+
+    if args.role == "local":
+        sup = runner.local_cluster(
+            cfg,
+            machines,
+            max_updates=args.max_updates,
+            publish_interval=args.publish_interval,
+            seed=args.seed,
+        )
+    elif args.role == "learner":
+        sup = runner.learner_role(
+            cfg,
+            machines,
+            max_updates=args.max_updates,
+            publish_interval=args.publish_interval,
+            seed=args.seed,
+        )
+    elif args.role == "manager":
+        sup = runner.manager_role(cfg, machines, machine_idx=args.machine_idx)
+    else:
+        sup = runner.worker_role(
+            cfg, machines, machine_idx=args.machine_idx, seed=args.seed
+        )
+
+    sup.install_signal_handlers()
+    try:
+        sup.loop()
+    finally:
+        sup.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Checkpoint / resume via orbax.
+
+Capability parity with the reference's ``torch.save`` every
+``model_save_interval`` updates + newest-file-wins resume
+(``/root/reference/agents/learner_module/ppo/learning.py:113-119``,
+``utils/utils.py:93-98``, ``main.py:128-146``), upgraded per SURVEY.md §5.4:
+the full train state is saved — params, optimizer state, and the update
+counter — so a resumed run continues instead of restarting its update index
+and re-warming its optimizer. Directory naming keeps the reference's
+``{algo}_{idx}`` convention so "newest index wins" is preserved.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import jax
+
+
+def _ckpt_dirs(model_dir: str, algo: str) -> list[tuple[int, str]]:
+    """[(idx, path)] of existing checkpoints, sorted by idx (reference index
+    parser ``utils/utils.py:93-98``)."""
+    if not os.path.isdir(model_dir):
+        return []
+    out = []
+    pat = re.compile(re.escape(algo) + r"_(\d+)$")
+    for name in os.listdir(model_dir):
+        m = pat.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(model_dir, name)))
+    return sorted(out)
+
+
+class Checkpointer:
+    def __init__(self, model_dir: str, algo: str, keep: int = 5):
+        self.model_dir = os.path.abspath(model_dir)
+        self.algo = algo
+        self.keep = keep
+        os.makedirs(self.model_dir, exist_ok=True)
+        import orbax.checkpoint as ocp
+
+        self._ckpt = ocp.StandardCheckpointer()
+
+    def save(self, state: Any, idx: int) -> str:
+        """Blocking save of the full train-state pytree as
+        ``{model_dir}/{algo}_{idx}``."""
+        path = os.path.join(self.model_dir, f"{self.algo}_{idx}")
+        self._ckpt.save(path, jax.device_get(state), force=True)
+        self._ckpt.wait_until_finished()
+        self._gc()
+        return path
+
+    def latest_idx(self) -> int | None:
+        found = _ckpt_dirs(self.model_dir, self.algo)
+        return found[-1][0] if found else None
+
+    def restore_latest(self, template: Any) -> tuple[Any, int] | None:
+        """Newest-index-wins restore into the structure of ``template``.
+        Returns (state, idx) or None when no checkpoint exists."""
+        found = _ckpt_dirs(self.model_dir, self.algo)
+        if not found:
+            return None
+        idx, path = found[-1]
+        restored = self._ckpt.restore(
+            path, jax.tree_util.tree_map(lambda x: x, template)
+        )
+        return restored, idx
+
+    def _gc(self) -> None:
+        """Bound disk usage (the reference keeps every checkpoint forever)."""
+        found = _ckpt_dirs(self.model_dir, self.algo)
+        for _idx, path in found[: -self.keep]:
+            import shutil
+
+            shutil.rmtree(path, ignore_errors=True)
+
+    def close(self) -> None:
+        self._ckpt.close()

@@ -1,0 +1,149 @@
+"""Cluster launcher: fan the role processes out over machines.
+
+Capability parity with the reference ``run.py`` (``/root/reference/run.py:28-99``):
+per-machine tmux session + ssh + rsync code push + role command, driven by the
+machines topology. Differences: commands are composed as argv lists (no shell
+string splicing), ``--dry-run`` prints the plan instead of executing, and the
+single-host path needs no ssh at all (``python -m tpu_rl local``).
+
+Usage:
+    python -m tpu_rl.launch --machines machines.json [--params params.json]
+        [--dry-run] [--ssh-user me] [--conda-env rl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+
+from tpu_rl.config import MachinesConfig
+
+RSYNC_EXCLUDES = [
+    ".git", "__pycache__", "results", "logs", "native/build",
+]  # reference run.py:15,21 exclude list
+
+
+def _remote(cmd: str, host: str, user: str | None) -> list[str]:
+    target = f"{user}@{host}" if user else host
+    return ["ssh", "-o", "StrictHostKeyChecking=accept-new", target, cmd]
+
+
+def _tmux_wrap(session: str, cmd: str) -> str:
+    """Run ``cmd`` inside a detached tmux session (reference run.py:28-29)."""
+    return (
+        f"tmux kill-session -t {session} 2>/dev/null; "
+        f"tmux new-session -d -s {session} {shlex.quote(cmd)}"
+    )
+
+
+def rsync_cmd(host: str, user: str | None, repo: str, dest: str) -> list[str]:
+    target = f"{user}@{host}:{dest}" if user else f"{host}:{dest}"
+    ex = [f"--exclude={e}" for e in RSYNC_EXCLUDES]
+    return ["rsync", "-az", "--delete", *ex, repo + "/", target]
+
+
+def role_cmd(
+    role: str,
+    machines_path: str,
+    params_path: str | None,
+    machine_idx: int | None = None,
+    python: str = "python",
+    conda_env: str | None = None,
+    workdir: str = "~/tpu_rl_deploy",
+) -> str:
+    parts = [python, "-m", "tpu_rl", role, "--machines", machines_path]
+    if params_path:
+        parts += ["--params", params_path]
+    if machine_idx is not None:
+        parts += ["--machine-idx", str(machine_idx)]
+    cmd = " ".join(parts)
+    if conda_env:  # reference run.py:40-41 conda activate
+        cmd = f"conda activate {conda_env} && {cmd}"
+    return f"cd {workdir} && {cmd}"
+
+
+def plan(
+    machines: MachinesConfig,
+    machines_path: str,
+    params_path: str | None,
+    repo: str,
+    ssh_user: str | None,
+    conda_env: str | None,
+    workdir: str = "~/tpu_rl_deploy",
+) -> list[list[str]]:
+    """The full launch plan as a list of argv commands, in execution order:
+    rsync to every machine, then learner, then per worker-machine a manager
+    and the workers (reference run.py:54-99)."""
+    cmds: list[list[str]] = []
+    hosts = (
+        {machines.learner_ip}
+        | {w.ip for w in machines.workers}
+        | {w.manager_ip for w in machines.workers}  # manager may be a 3rd host
+    )
+    for host in sorted(hosts):
+        cmds.append(rsync_cmd(host, ssh_user, repo, workdir))
+    cmds.append(
+        _remote(
+            _tmux_wrap(
+                "tpurl-learner",
+                role_cmd("learner", machines_path, params_path,
+                         conda_env=conda_env, workdir=workdir),
+            ),
+            machines.learner_ip,
+            ssh_user,
+        )
+    )
+    for idx, w in enumerate(machines.workers):
+        cmds.append(
+            _remote(
+                _tmux_wrap(
+                    f"tpurl-manager-{idx}",
+                    role_cmd("manager", machines_path, params_path, idx,
+                             conda_env=conda_env, workdir=workdir),
+                ),
+                w.manager_ip,
+                ssh_user,
+            )
+        )
+        cmds.append(
+            _remote(
+                _tmux_wrap(
+                    f"tpurl-worker-{idx}",
+                    role_cmd("worker", machines_path, params_path, idx,
+                             conda_env=conda_env, workdir=workdir),
+                ),
+                w.ip,
+                ssh_user,
+            )
+        )
+    return cmds
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu_rl.launch")
+    p.add_argument("--machines", required=True)
+    p.add_argument("--params")
+    p.add_argument("--repo", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    p.add_argument("--ssh-user")
+    p.add_argument("--conda-env")
+    p.add_argument("--workdir", default="~/tpu_rl_deploy")
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args(argv)
+
+    machines = MachinesConfig.from_json(args.machines)
+    cmds = plan(
+        machines, args.machines, args.params, args.repo,
+        args.ssh_user, args.conda_env, args.workdir,
+    )
+    for cmd in cmds:
+        print("$", " ".join(shlex.quote(c) for c in cmd))
+        if not args.dry_run:
+            subprocess.run(cmd, check=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

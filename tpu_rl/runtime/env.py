@@ -1,0 +1,102 @@
+"""Environment adapter.
+
+Parity with the reference's ``EnvBase``
+(``/root/reference/agents/worker_module/env_maker.py:6-31``): gymnasium env
+with float32 flattened observations, ``terminated or truncated`` collapsed to
+one done flag, and continuous actions adapted between the policy's flat vector
+and the env's Box space. The conv/image path the reference carries disabled
+(``utils/utils.py:201-226``) is represented by the same config flags but
+implemented as a plain resize+gray transform when enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from tpu_rl.config import Config
+
+
+def probe_spaces(cfg: Config) -> Config:
+    """Fill runtime-derived obs/action-space fields by probing the env once
+    (reference ``main.py:82-95``)."""
+    import gymnasium as gym
+
+    env = gym.make(cfg.env)
+    obs_space = env.observation_space
+    act_space = env.action_space
+    env.close()
+    if hasattr(act_space, "n"):  # Discrete
+        action_space, continuous = int(act_space.n), False
+    else:  # Box
+        action_space, continuous = int(np.prod(act_space.shape)), True
+    if cfg.need_conv:
+        # The adapter resizes to (height, width[, c]) then flattens; obs_shape
+        # must describe the PREPROCESSED observation the models consume.
+        channels = 1 if cfg.is_gray else (
+            obs_space.shape[-1] if len(obs_space.shape) == 3 else 1
+        )
+        obs_shape: tuple[int, ...] = (cfg.height * cfg.width * channels,)
+    else:
+        obs_shape = tuple(int(s) for s in obs_space.shape)
+    return cfg.replace(
+        obs_shape=obs_shape,
+        action_space=action_space,
+        is_continuous=continuous,
+    )
+
+
+class EnvAdapter:
+    """Reset/step with preprocessed observations and a single done flag."""
+
+    def __init__(self, cfg: Config, seed: int | None = None):
+        import gymnasium as gym
+
+        self.cfg = cfg
+        self.env = gym.make(cfg.env)
+        self._seed = seed
+        self._continuous = cfg.is_continuous
+        self._act_space = self.env.action_space
+
+    def _preprocess(self, obs: Any) -> np.ndarray:
+        arr = np.asarray(obs, np.float32)
+        if self.cfg.need_conv:
+            arr = self._conv_preprocess(arr)
+        # Models consume flat vectors; preprocessed obs always flatten.
+        return arr.reshape(-1) if arr.ndim > 1 else arr
+
+    def _conv_preprocess(self, arr: np.ndarray) -> np.ndarray:
+        """Resize (+optional grayscale) image observations — the capability the
+        reference gates behind ``need_conv`` but leaves disabled."""
+        h, w = self.cfg.height, self.cfg.width
+        if self.cfg.is_gray and arr.ndim == 3 and arr.shape[-1] == 3:
+            arr = arr @ np.asarray([0.299, 0.587, 0.114], np.float32)
+        # Nearest-neighbor resize without cv2 (not in the image).
+        ys = (np.linspace(0, arr.shape[0] - 1, h)).astype(np.int64)
+        xs = (np.linspace(0, arr.shape[1] - 1, w)).astype(np.int64)
+        return arr[np.ix_(ys, xs)].astype(np.float32) / 255.0
+
+    def reset(self) -> np.ndarray:
+        if self._seed is not None:
+            obs, _ = self.env.reset(seed=self._seed)
+            self._seed = None  # gymnasium: seed once, then evolve
+        else:
+            obs, _ = self.env.reset()
+        return self._preprocess(obs)
+
+    def step(self, action: np.ndarray) -> tuple[np.ndarray, float, bool]:
+        """action: policy-side float vector — (1,) index for discrete, (A,)
+        for continuous (reference ``action_preprocess``,
+        ``env_maker.py:15-26``)."""
+        if self._continuous:
+            env_action = np.asarray(action, np.float32).reshape(
+                self._act_space.shape
+            )
+        else:
+            env_action = int(np.asarray(action).reshape(-1)[0])
+        obs, rew, term, trunc, _info = self.env.step(env_action)
+        return self._preprocess(obs), float(rew), bool(term or trunc)
+
+    def close(self) -> None:
+        self.env.close()

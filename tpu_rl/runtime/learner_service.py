@@ -1,0 +1,210 @@
+"""Learner process: the only process that owns the TPU.
+
+Capability parity with the reference learner
+(``/root/reference/agents/learner.py:39-305`` + the per-algo loops in
+``agents/learner_module/*/learning.py``): sample trajectory batches out of
+shared memory, run the algorithm's update, broadcast fresh policy weights to
+every worker, log losses/timers/fleet-reward to tensorboard, checkpoint every
+``model_save_interval`` updates, heartbeat.
+
+TPU-first redesign:
+- the six per-algo asyncio coroutines collapse into ONE loop around the
+  algorithm's pure jitted ``train_step`` (the registry supplies it);
+- when ``cfg.mesh_data > 1`` the step is compiled with GSPMD shardings over
+  the data mesh (``tpu_rl.parallel.dp``) — XLA inserts the ICI gradient
+  all-reduce the reference has no equivalent of;
+- weight broadcast is ``jax.device_get`` of the actor tree only, throttled by
+  ``publish_interval`` instead of once per update, so host transfer never
+  stalls the device pipeline (SURVEY.md §7 hard-parts);
+- checkpoints carry params + optimizer state + update counter (orbax).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from tpu_rl.config import Config, is_off_policy
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.data.shm_ring import ShmHandles, make_store
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.transport import MODEL_HWM, Pub
+from tpu_rl.utils.metrics import LearnerLogger, make_writer
+from tpu_rl.utils.timer import ExecutionTimer
+
+
+class LearnerService:
+    def __init__(
+        self,
+        cfg: Config,
+        handles: ShmHandles,
+        model_port: int,
+        stat_array=None,
+        stop_event=None,
+        heartbeat=None,
+        max_updates: int | None = None,
+        publish_interval: int = 1,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.handles = handles
+        self.model_port = model_port
+        self.stat_array = stat_array
+        self.stop_event = stop_event
+        self.heartbeat = heartbeat
+        self.max_updates = max_updates
+        self.publish_interval = publish_interval
+        self.seed = seed
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> None:
+        import jax
+
+        from tpu_rl.algos.registry import get_algo
+        from tpu_rl.checkpoint import Checkpointer
+
+        cfg = self.cfg
+        layout = BatchLayout.from_config(cfg)
+        store = make_store(cfg, layout, handles=self.handles)
+        off_policy = is_off_policy(cfg.algo)
+        rng = np.random.default_rng(self.seed)
+
+        family, state, train_step = get_algo(cfg.algo).build(
+            cfg, jax.random.key(self.seed)
+        )
+
+        # ---- checkpoint resume (newest index wins, SURVEY.md §5.4) ----
+        ckpt = None
+        start_idx = 0
+        if cfg.model_dir:
+            ckpt = Checkpointer(cfg.model_dir, cfg.algo)
+            restored = ckpt.restore_latest(state)
+            if restored is not None:
+                state, start_idx = restored
+                print(f"[learner] resumed from checkpoint idx {start_idx}")
+
+        # ---- compile: single-chip jit or GSPMD data-parallel mesh ----
+        if cfg.mesh_data > 1:
+            from tpu_rl.parallel.dp import make_parallel_train_step, replicate
+            from tpu_rl.parallel.mesh import make_mesh
+
+            mesh = make_mesh(cfg.mesh_data)
+            train_step = make_parallel_train_step(train_step, mesh, cfg)
+            state = replicate(state, mesh)
+        else:
+            train_step = jax.jit(train_step, donate_argnums=(0,))
+
+        pub = Pub("*", self.model_port, bind=True, hwm=MODEL_HWM)
+        writer = make_writer(cfg.result_dir)
+        logger = LearnerLogger(writer, cfg.algo)
+        timer = ExecutionTimer(num_transition=cfg.seq_len * cfg.batch_size)
+        key = jax.random.key(self.seed + 1)
+
+        # First broadcast so workers act with the resumed/initial policy
+        # rather than their own random init.
+        self._publish(pub, state)
+
+        idx = start_idx
+        try:
+            while not self._stopped():
+                if self.max_updates is not None and idx - start_idx >= self.max_updates:
+                    break
+                # Idle polls stay OUTSIDE the throughput timer: an empty-store
+                # iteration processes zero transitions and must not inflate
+                # the learner-FPS window.
+                t_sample = time.perf_counter()
+                raw = self._next_batch(store, rng)
+                if raw is None:
+                    if self.heartbeat is not None:
+                        self.heartbeat.value = time.time()
+                    time.sleep(0.002)
+                    continue
+                with timer.timer("learner-throughput", check_throughput=True):
+                    batch = self._to_batch(raw)
+                    timer.record(
+                        "learner-batching-time", time.perf_counter() - t_sample
+                    )
+                    with timer.timer("learner-step-time"):
+                        key, sub_key = jax.random.split(key)
+                        state, metrics = train_step(state, batch, sub_key)
+                idx += 1
+
+                if idx % self.publish_interval == 0:
+                    self._publish(pub, state)
+                if idx % cfg.loss_log_interval == 0:
+                    jax.block_until_ready(metrics)
+                    logger.log_losses(idx, {k: float(v) for k, v in metrics.items()})
+                    logger.log_timers(idx, timer)
+                    self._log_fleet_stat(logger)
+                    logger.flush()
+                if ckpt is not None and idx % cfg.model_save_interval == 0:
+                    ckpt.save(state, idx)
+                if self.heartbeat is not None:
+                    self.heartbeat.value = time.time()
+        finally:
+            if ckpt is not None and idx > start_idx:
+                ckpt.save(state, idx)
+                ckpt.close()
+            pub.close()
+            writer.close()
+
+    # ------------------------------------------------------------- batching
+    def _next_batch(self, store, rng) -> dict | None:
+        if is_off_policy(self.cfg.algo):
+            return store.sample(self.cfg.batch_size, rng)
+        return store.consume()
+
+    def _to_batch(self, raw: dict):
+        from tpu_rl.types import Batch
+
+        return Batch.from_mapping(raw)
+
+    # ------------------------------------------------------------ broadcast
+    def _publish(self, pub: Pub, state) -> None:
+        """Ship the actor tree as host numpy (SAC broadcasts the actor only,
+        reference ``sac/learning.py:145``)."""
+        import jax
+
+        actor = (
+            state.actor_params
+            if hasattr(state, "actor_params")
+            else state.params["actor"]
+        )
+        pub.send(Protocol.Model, {"actor": jax.device_get(actor)})
+
+    def _log_fleet_stat(self, logger: LearnerLogger) -> None:
+        """Consume the stat mailbox if storage activated it (reference
+        ``agents/learner.py:136-148``)."""
+        sa = self.stat_array
+        if sa is not None and sa[2] >= 1.0:
+            logger.log_stat(int(sa[0]), float(sa[1]))
+            sa[2] = 0.0
+
+    def _stopped(self) -> bool:
+        return self.stop_event is not None and self.stop_event.is_set()
+
+
+def learner_main(
+    cfg: Config,
+    handles: ShmHandles,
+    model_port: int,
+    stat_array,
+    stop_event,
+    heartbeat,
+    max_updates=None,
+    publish_interval: int = 1,
+    seed: int = 0,
+) -> None:
+    """mp.Process target (reference ``run_learner``, ``main.py:189-226``)."""
+    LearnerService(
+        cfg,
+        handles,
+        model_port,
+        stat_array,
+        stop_event,
+        heartbeat,
+        max_updates,
+        publish_interval,
+        seed,
+    ).run()

@@ -1,0 +1,107 @@
+"""Manager relay process: per-machine fan-in between workers and the learner
+storage.
+
+Capability parity with the reference manager
+(``/root/reference/agents/manager.py:11-90``): SUB-bind on the machine's
+worker port, forward Rollout messages to the learner storage, window worker
+episode rewards and publish the mean every ``stat_window`` episodes. The
+bounded drop-oldest queue (deque maxlen 1024, ``manager.py:45-47``) is kept —
+back-pressure on a best-effort fleet means shedding the *oldest* data, since
+stale rollouts are the least on-policy.
+
+Sync loop instead of the reference's two asyncio tasks: one poll-drain-forward
+pass per iteration keeps ordering within a worker's stream and needs no
+coordination.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from tpu_rl.config import Config
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.transport import Pub, Sub
+
+RELAY_QUEUE_MAX = 1024  # reference manager.py:45-47
+STAT_WINDOW = 50  # reference manager.py:19,62-79
+
+
+class Manager:
+    def __init__(
+        self,
+        cfg: Config,
+        worker_port: int,
+        learner_ip: str,
+        learner_port: int,
+        stop_event=None,
+        heartbeat=None,
+    ):
+        self.cfg = cfg
+        self.worker_port = worker_port
+        self.learner_addr = (learner_ip, learner_port)
+        self.stop_event = stop_event
+        self.heartbeat = heartbeat
+        self.queue: deque = deque(maxlen=RELAY_QUEUE_MAX)
+        self.stat_q: deque = deque(maxlen=STAT_WINDOW)
+        self.n_stats = 0
+        self.n_forwarded = 0
+
+    def run(self) -> None:
+        sub = Sub("*", self.worker_port, bind=True)
+        pub = Pub(*self.learner_addr, bind=False)
+        try:
+            while not self._stopped():
+                moved = self._pump(sub, pub)
+                if self.heartbeat is not None:
+                    self.heartbeat.value = time.time()
+                if not moved:
+                    # Idle: block briefly on the socket instead of spinning.
+                    msg = sub.recv(timeout_ms=50)
+                    if msg is not None:
+                        self._ingest(*msg, pub)
+        finally:
+            sub.close()
+            pub.close()
+
+    # ---------------------------------------------------------------- pump
+    def _pump(self, sub: Sub, pub: Pub) -> int:
+        moved = 0
+        for proto, payload in sub.drain():
+            self._ingest(proto, payload, pub)
+            moved += 1
+        while self.queue:
+            pub.send(Protocol.Rollout, self.queue.popleft())
+            self.n_forwarded += 1
+            moved += 1
+        return moved
+
+    def _ingest(self, proto: Protocol, payload, pub: Pub) -> None:
+        if proto == Protocol.Rollout:
+            self.queue.append(payload)  # drop-oldest at maxlen
+        elif proto == Protocol.Stat:
+            self.stat_q.append(float(payload))
+            self.n_stats += 1
+            if self.n_stats % STAT_WINDOW == 0:
+                mean = sum(self.stat_q) / len(self.stat_q)
+                pub.send(
+                    Protocol.Stat, {"mean": mean, "n": len(self.stat_q)}
+                )
+
+    def _stopped(self) -> bool:
+        return self.stop_event is not None and self.stop_event.is_set()
+
+
+def manager_main(
+    cfg: Config,
+    worker_port: int,
+    learner_ip: str,
+    learner_port: int,
+    stop_event,
+    heartbeat,
+) -> None:
+    """mp.Process target (reference ``manager_sub_process``,
+    ``main.py:228-242``)."""
+    Manager(
+        cfg, worker_port, learner_ip, learner_port, stop_event, heartbeat
+    ).run()

@@ -1,0 +1,110 @@
+"""Learner-storage process: bridge from the DCN transport into device-feedable
+shared memory.
+
+Capability parity with the reference ``LearnerStorage``
+(``/root/reference/agents/learner_storage.py:25-159``): SUB-bind on the
+learner port, push Rollout steps through the assembler, write completed
+windows into the shm store, relay episode-reward stats into the 3-float stat
+mailbox ``[global_game_count, mean_rew, activate]``
+(``learner_storage.py:104-121``, created at ``main.py:324-326``).
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpu_rl.config import Config
+from tpu_rl.data.assembler import RolloutAssembler
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.data.shm_ring import ShmHandles, make_store
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.transport import Sub
+
+STAT_SLOTS = 3  # [game_count, mean_rew, activate]
+
+
+class LearnerStorage:
+    def __init__(
+        self,
+        cfg: Config,
+        handles: ShmHandles,
+        learner_port: int,
+        stat_array=None,
+        stop_event=None,
+        heartbeat=None,
+    ):
+        self.cfg = cfg
+        self.handles = handles
+        self.learner_port = learner_port
+        self.stat_array = stat_array
+        self.stop_event = stop_event
+        self.heartbeat = heartbeat
+        self.game_count = 0
+        self.n_windows = 0
+        self.n_requeue_full = 0  # windows requeued because the store was full
+
+    def run(self) -> None:
+        cfg = self.cfg
+        layout = BatchLayout.from_config(cfg)
+        assembler = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
+        store = make_store(cfg, layout, handles=self.handles)
+        sub = Sub("*", self.learner_port, bind=True)
+        try:
+            while not self._stopped():
+                msg = sub.recv(timeout_ms=50)
+                if msg is not None:
+                    self._ingest(*msg, assembler)
+                for proto, payload in sub.drain():
+                    self._ingest(proto, payload, assembler)
+                self._flush(assembler, store)
+                if self.heartbeat is not None:
+                    self.heartbeat.value = time.time()
+        finally:
+            sub.close()
+
+    def _ingest(self, proto: Protocol, payload, assembler) -> None:
+        if proto == Protocol.Rollout:
+            assembler.push(payload)
+        elif proto == Protocol.Stat:
+            self._relay_stat(payload)
+
+    def _flush(self, assembler: RolloutAssembler, store) -> None:
+        while (window := assembler.pop()) is not None:
+            if not store.put(window):
+                # On-policy store full: the learner hasn't consumed yet.
+                # Requeue the window and yield (reference spins on
+                # ``num < mem_size``, ``learner_storage.py:139``).
+                assembler.ready.appendleft(window)
+                self.n_requeue_full += 1
+                break
+            self.n_windows += 1
+
+    def _relay_stat(self, payload) -> None:
+        """Manager sends ``{"mean": m, "n": window}``; fold into the stat
+        mailbox for the learner's tensorboard tick
+        (``learner_storage.py:104-121``)."""
+        if self.stat_array is None:
+            return
+        mean = float(payload["mean"]) if isinstance(payload, dict) else float(payload)
+        n = int(payload.get("n", 1)) if isinstance(payload, dict) else 1
+        self.game_count += n
+        self.stat_array[0] = float(self.game_count)
+        self.stat_array[1] = mean
+        self.stat_array[2] = 1.0  # activate flag; learner clears it
+
+    def _stopped(self) -> bool:
+        return self.stop_event is not None and self.stop_event.is_set()
+
+
+def storage_main(
+    cfg: Config,
+    handles: ShmHandles,
+    learner_port: int,
+    stat_array,
+    stop_event,
+    heartbeat,
+) -> None:
+    """mp.Process target (reference ``storage_run``, ``main.py:164-187``)."""
+    LearnerStorage(
+        cfg, handles, learner_port, stat_array, stop_event, heartbeat
+    ).run()

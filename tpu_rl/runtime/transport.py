@@ -59,32 +59,44 @@ class Pub:
 
 
 class Sub:
-    """Synchronous SUB endpoint subscribed to everything."""
+    """Synchronous SUB endpoint subscribed to everything.
+
+    Malformed/foreign frames (``decode`` raising ValueError) are dropped and
+    counted, never raised — one stray publisher on a best-effort PUB/SUB
+    fabric must not crash a role process."""
 
     def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.SUB)
         self.sock.set_hwm(hwm)
         self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        self.n_rejected = 0
         ep = _endpoint(ip, port)
         self.sock.bind(ep) if bind else self.sock.connect(ep)
 
     def recv(self, timeout_ms: int | None = None) -> tuple[Protocol, Any] | None:
         """Blocking (or timed) receive of one decoded message; None on
-        timeout."""
+        timeout or on a rejected frame."""
         if timeout_ms is not None:
             if not self.sock.poll(timeout_ms):
                 return None
-        return decode(self.sock.recv_multipart())
+        try:
+            return decode(self.sock.recv_multipart())
+        except ValueError:
+            self.n_rejected += 1
+            return None
 
     def drain(self, max_msgs: int = 1024) -> Iterator[tuple[Protocol, Any]]:
-        """Yield every message currently queued, newest-bounded."""
+        """Yield every decodable message currently queued, newest-bounded."""
         for _ in range(max_msgs):
             try:
                 parts = self.sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
                 return
-            yield decode(parts)
+            try:
+                yield decode(parts)
+            except ValueError:
+                self.n_rejected += 1
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -99,11 +111,17 @@ class AsyncSub:
         self.sock = self._ctx.socket(zmq.SUB)
         self.sock.set_hwm(hwm)
         self.sock.setsockopt(zmq.SUBSCRIBE, b"")
+        self.n_rejected = 0
         ep = _endpoint(ip, port)
         self.sock.bind(ep) if bind else self.sock.connect(ep)
 
     async def recv(self) -> tuple[Protocol, Any]:
-        return decode(await self.sock.recv_multipart())
+        """Receive the next decodable message (rejected frames are dropped)."""
+        while True:
+            try:
+                return decode(await self.sock.recv_multipart())
+            except ValueError:
+                self.n_rejected += 1
 
     def close(self) -> None:
         self.sock.close(linger=0)

@@ -1,0 +1,160 @@
+"""Actor (worker) process: step the env with the latest broadcast policy and
+stream per-step transitions to the manager relay.
+
+Capability parity with the reference worker
+(``/root/reference/agents/worker.py:14-142``): per-step rollout publish,
+per-episode stat publish, hot weight reload from the learner broadcast,
+``time_horizon`` episode cap, reward scaling, step throttle, heartbeat.
+Re-designed: a single synchronous loop that drains the model SUB between env
+steps (the reference runs two asyncio tasks for the same effect); inference is
+a jitted pure function over explicit ``(params, obs, h, c, key)`` so a weight
+swap is one pointer assignment, never a mid-step mutation
+(the reference hot-swaps ``load_state_dict`` mid-episode).
+
+Workers are CPU processes by design — the learner owns the TPU; the runner
+forces ``JAX_PLATFORMS=cpu`` into worker/manager/storage children.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+
+import numpy as np
+
+from tpu_rl.config import Config
+from tpu_rl.runtime.env import EnvAdapter
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.transport import MODEL_HWM, Pub, Sub
+
+
+class Worker:
+    def __init__(
+        self,
+        cfg: Config,
+        worker_id: int,
+        manager_ip: str,
+        manager_port: int,
+        learner_ip: str,
+        model_port: int,
+        stop_event=None,
+        heartbeat=None,
+        initial_params=None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.worker_id = worker_id
+        self.addr = (manager_ip, manager_port, learner_ip, model_port)
+        self.stop_event = stop_event
+        self.heartbeat = heartbeat
+        self.initial_params = initial_params
+        self.seed = seed
+
+    # ------------------------------------------------------------------ run
+    def run(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_rl.models.families import build_family
+
+        cfg = self.cfg
+        manager_ip, manager_port, learner_ip, model_port = self.addr
+        pub = Pub(manager_ip, manager_port, bind=False)
+        model_sub = Sub(learner_ip, model_port, bind=False, hwm=MODEL_HWM)
+
+        family = build_family(cfg)
+        key = jax.random.key(self.seed * 9973 + self.worker_id)
+        if self.initial_params is not None:
+            params = self.initial_params  # checkpoint-resume parity
+        else:
+            key, init_key = jax.random.split(key)
+            params = family.init_params(init_key, seq_len=cfg.seq_len)
+        act = jax.jit(family.act)
+
+        env = EnvAdapter(cfg, seed=self.seed * 131 + self.worker_id)
+        h = jnp.zeros((1, cfg.hidden_size))
+        c = jnp.zeros((1, cfg.hidden_size))
+        obs = env.reset()
+        episode_id = uuid.uuid4().hex
+        is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
+        n_model_loads = 0
+
+        try:
+            while not self._stopped():
+                # Hot-reload the freshest broadcast params (reference
+                # ``req_model`` task, ``worker.py:62-72``).
+                for proto, payload in model_sub.drain(max_msgs=MODEL_HWM):
+                    if proto == Protocol.Model:
+                        params = {"actor": payload["actor"]}
+                        n_model_loads += 1
+
+                key, sub_key = jax.random.split(key)
+                ob = jnp.asarray(obs, jnp.float32)[None]
+                a, logits, log_prob, h2, c2 = act(params, ob, h, c, sub_key)
+                next_obs, rew, done = env.step(np.asarray(a[0]))
+                epi_rew += rew
+                epi_steps += 1
+                horizon_hit = epi_steps >= cfg.time_horizon
+                step_msg = dict(
+                    obs=np.asarray(ob[0]),
+                    act=np.asarray(a[0]),
+                    rew=np.asarray([rew * cfg.reward_scale], np.float32),
+                    logits=np.asarray(logits[0]),
+                    log_prob=np.asarray(log_prob[0]),
+                    is_fir=np.asarray([is_fir], np.float32),
+                    hx=np.asarray(h[0]),
+                    cx=np.asarray(c[0]),
+                    id=episode_id,
+                    done=bool(done or horizon_hit),
+                )
+                pub.send(Protocol.Rollout, step_msg)
+
+                is_fir = 0.0
+                obs, h, c = next_obs, h2, c2
+                if done or horizon_hit:
+                    pub.send(Protocol.Stat, float(epi_rew))
+                    obs = env.reset()
+                    h = jnp.zeros_like(h)
+                    c = jnp.zeros_like(c)
+                    episode_id = uuid.uuid4().hex
+                    is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
+
+                if self.heartbeat is not None:
+                    self.heartbeat.value = time.time()
+                if cfg.worker_step_sleep > 0:
+                    # Reference throttle (``worker.py:131``); 0 disables.
+                    time.sleep(cfg.worker_step_sleep)
+        finally:
+            env.close()
+            pub.close()
+            model_sub.close()
+
+    def _stopped(self) -> bool:
+        return self.stop_event is not None and self.stop_event.is_set()
+
+
+def worker_main(
+    cfg: Config,
+    worker_id: int,
+    manager_ip: str,
+    manager_port: int,
+    learner_ip: str,
+    model_port: int,
+    stop_event,
+    heartbeat,
+    initial_params=None,
+    seed: int = 0,
+) -> None:
+    """mp.Process target (reference ``worker_run``, ``main.py:155-162``)."""
+    Worker(
+        cfg,
+        worker_id,
+        manager_ip,
+        manager_port,
+        learner_ip,
+        model_port,
+        stop_event,
+        heartbeat,
+        initial_params,
+        seed,
+    ).run()

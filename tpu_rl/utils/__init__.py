@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: timers, metrics, checkpointing helpers."""

@@ -1,0 +1,57 @@
+"""Tensorboard observability.
+
+Parity with the reference's learner-side logging
+(``/root/reference/agents/learner.py:95-158``): per-algorithm loss scalars,
+timer scalars, and the fleet-wide ``50-game-mean-stat-of-epi-rew`` keyed by
+global game count. tensorboardX writes the same event files the reference
+produces; a no-op writer keeps headless/test runs dependency-quiet.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+
+class NullWriter:
+    def add_scalar(self, *a, **kw) -> None: ...
+
+    def flush(self) -> None: ...
+
+    def close(self) -> None: ...
+
+
+def make_writer(result_dir: str | None):
+    if result_dir is None:
+        return NullWriter()
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter(result_dir)
+    except Exception:
+        return NullWriter()
+
+
+class LearnerLogger:
+    """Scalar fan-out for the learner loop (names follow the reference so
+    dashboards transfer)."""
+
+    def __init__(self, writer, algo: str):
+        self.w = writer
+        self.algo = algo
+
+    def log_losses(self, step: int, metrics: Mapping[str, float]) -> None:
+        for name, val in metrics.items():
+            self.w.add_scalar(f"{self.algo}/{name}", float(val), step)
+
+    def log_timers(self, step: int, timer) -> None:
+        for name, val in timer.scalars().items():
+            self.w.add_scalar(f"perf/{name}", float(val), step)
+
+    def log_stat(self, game_count: int, mean_rew: float) -> None:
+        # Reference scalar name: agents/learner.py:146
+        self.w.add_scalar(
+            "50-game-mean-stat-of-epi-rew", float(mean_rew), game_count
+        )
+
+    def flush(self) -> None:
+        self.w.flush()

@@ -1,0 +1,62 @@
+"""Wall-clock instrumentation for the learner hot loop.
+
+Parity with the reference's ``ExecutionTimer``
+(``/root/reference/utils/utils.py:167-189``): named context-manager blocks
+append elapsed seconds (and optionally transitions/sec) into bounded windows,
+surfaced to tensorboard as ``<name>-elapsed-mean-sec`` /
+``<name>-transition-per-secs`` (``agents/learner.py:150-158``). This is the
+instrument behind the BASELINE "learner FPS" metric (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict, deque
+
+
+class ExecutionTimer:
+    def __init__(self, num_transition: int = 0, window: int = 100):
+        self.num_transition = num_transition  # seq_len * batch_size
+        self.elapsed: dict[str, deque] = defaultdict(lambda: deque(maxlen=window))
+        self.throughput: dict[str, deque] = defaultdict(
+            lambda: deque(maxlen=window)
+        )
+
+    @contextlib.contextmanager
+    def timer(self, name: str, check_throughput: bool = False):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = time.perf_counter() - t0
+            self.elapsed[name].append(dt)
+            if check_throughput and self.num_transition and dt > 0:
+                self.throughput[name].append(self.num_transition / dt)
+
+    def record(self, name: str, dt: float) -> None:
+        """Record an externally-measured duration (for spans whose success is
+        only known after the fact, e.g. a store poll that found data)."""
+        self.elapsed[name].append(dt)
+
+    def mean_elapsed(self, name: str) -> float | None:
+        q = self.elapsed.get(name)
+        return sum(q) / len(q) if q else None
+
+    def mean_throughput(self, name: str) -> float | None:
+        q = self.throughput.get(name)
+        return sum(q) / len(q) if q else None
+
+    def scalars(self) -> dict[str, float]:
+        """All windows reduced to means, keyed with the reference's
+        tensorboard naming."""
+        out = {}
+        for name in self.elapsed:
+            m = self.mean_elapsed(name)
+            if m is not None:
+                out[f"{name}-elapsed-mean-sec"] = m
+        for name in self.throughput:
+            m = self.mean_throughput(name)
+            if m is not None:
+                out[f"{name}-transition-per-secs"] = m
+        return out
