@@ -1,0 +1,134 @@
+"""Sequence-parallelism tests: ring / Ulysses attention must be EXACTLY
+equivalent (up to float tolerance) to single-device attention, for outputs
+and gradients, on the virtual 8-device CPU mesh (SURVEY.md §4 pattern)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from tpu_rl.parallel.sequence import (
+    SEQ_AXIS,
+    full_attention,
+    make_sp_mesh,
+    ring_attention,
+    segment_ids_from_firsts,
+    ulysses_attention,
+)
+
+
+def _inputs(rng, B=2, T=32, H=4, D=8, n_segments=3):
+    q = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    k = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    v = rng.normal(size=(B, T, H, D)).astype(np.float32)
+    pos = np.broadcast_to(np.arange(T, dtype=np.int32), (B, T)).copy()
+    # random episode seams -> segment ids
+    firsts = np.zeros((B, T, 1), np.float32)
+    firsts[:, 0] = 1.0
+    for b in range(B):
+        seams = rng.choice(np.arange(1, T), size=n_segments - 1, replace=False)
+        firsts[b, seams] = 1.0
+    seg = np.asarray(segment_ids_from_firsts(jnp.asarray(firsts)))
+    return map(jnp.asarray, (q, k, v, pos, seg))
+
+
+def _sharded_attn(impl, mesh, n_seq):
+    """shard_map the impl over the seq axis of a (1, n_seq) mesh."""
+    spec = P(None, SEQ_AXIS)  # (B, T) ints
+    qspec = P(None, SEQ_AXIS, None, None)  # (B, T, H, D)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, spec, spec),
+        out_specs=qspec,
+        check_rep=False,
+    )
+    def fn(q, k, v, pos, seg):
+        return impl(q, k, v, pos, seg, axis_name=SEQ_AXIS, causal=True)
+
+    return fn
+
+
+@pytest.mark.parametrize("impl_name", ["ring", "ulysses"])
+def test_sharded_matches_full(devices, rng, impl_name):
+    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[impl_name]
+    n_seq = 4
+    mesh = make_sp_mesh(1, n_seq)
+    q, k, v, pos, seg = _inputs(rng)
+    want = full_attention(q, k, v, pos, seg, causal=True)
+    got = jax.jit(_sharded_attn(impl, mesh, n_seq))(q, k, v, pos, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+@pytest.mark.parametrize("impl_name", ["ring", "ulysses"])
+def test_sharded_gradients_match(devices, rng, impl_name):
+    """Backprop through ppermute/all_to_all is exact."""
+    impl = {"ring": ring_attention, "ulysses": ulysses_attention}[impl_name]
+    mesh = make_sp_mesh(1, 4)
+    q, k, v, pos, seg = _inputs(rng, T=16)
+    sharded = _sharded_attn(impl, mesh, 4)
+
+    def loss_full(qkv):
+        return (full_attention(*qkv, pos, seg, causal=True) ** 2).sum()
+
+    def loss_sharded(qkv):
+        return (sharded(*qkv, pos, seg) ** 2).sum()
+
+    g_want = jax.grad(loss_full)((q, k, v))
+    g_got = jax.jit(jax.grad(loss_sharded))((q, k, v))
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+
+def test_causal_masking(rng):
+    """Row t must not depend on any input at positions > t."""
+    q, k, v, pos, seg = _inputs(rng, B=1, T=8, n_segments=1)
+    out1 = full_attention(q, k, v, pos, seg, causal=True)
+    # perturb the future of position 3
+    k2 = k.at[:, 5:].set(0.0)
+    v2 = v.at[:, 5:].set(99.0)
+    out2 = full_attention(q, k2, v2, pos, seg, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :5]), np.asarray(out2[:, :5]), atol=1e-6
+    )
+    assert not np.allclose(np.asarray(out1[:, 5:]), np.asarray(out2[:, 5:]))
+
+
+def test_segment_masking_blocks_cross_episode(rng):
+    """Attention must not cross an is_fir seam (episode boundary)."""
+    B, T = 1, 8
+    q, k, v, _, _ = _inputs(rng, B=B, T=T, n_segments=1)
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    # seam at t=4: two episodes [0..3], [4..7]
+    firsts = np.zeros((B, T, 1), np.float32)
+    firsts[:, 0] = 1.0
+    firsts[:, 4] = 1.0
+    seg = segment_ids_from_firsts(jnp.asarray(firsts))
+    out1 = full_attention(q, k, v, pos, seg, causal=True)
+    # changing episode-1 inputs must not affect episode-2 outputs
+    k2 = k.at[:, :4].set(7.0)
+    v2 = v.at[:, :4].set(-3.0)
+    out2 = full_attention(q, k2, v2, pos, seg, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, 4:]), np.asarray(out2[:, 4:]), atol=1e-6
+    )
+
+
+def test_segment_ids_from_firsts():
+    firsts = jnp.asarray(
+        [[[1.0], [0.0], [1.0], [0.0], [0.0]]], jnp.float32
+    )
+    seg = segment_ids_from_firsts(firsts)
+    np.testing.assert_array_equal(np.asarray(seg), [[1, 1, 2, 2, 2]])
+
+
+def test_dp_sp_mesh_shapes(devices):
+    mesh = make_sp_mesh(2, 4)
+    assert mesh.shape == {"data": 2, "seq": 4}
+    with pytest.raises(ValueError):
+        make_sp_mesh(4, 4)  # 16 > 8 devices

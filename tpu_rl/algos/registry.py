@@ -24,9 +24,10 @@ class AlgoSpec:
     on_policy: bool  # on-policy ring vs off-policy replay (main.py:310-321)
     make_train_step: Callable[[Config, ModelFamily], Callable]
 
-    def build(self, cfg: Config, key: jax.Array):
-        """Returns (family, initial_state, train_step)."""
-        family = build_family(cfg)
+    def build(self, cfg: Config, key: jax.Array, mesh=None):
+        """Returns (family, initial_state, train_step). ``mesh`` is only
+        needed for sequence-parallel transformer families."""
+        family = build_family(cfg, mesh=mesh)
         state = make_train_state(cfg, family, key)
         return family, state, self.make_train_step(cfg, family)
 

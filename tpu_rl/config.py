@@ -45,6 +45,16 @@ class Config:
 
     # model
     hidden_size: int = 64
+    # Policy backbone: "lstm" (reference parity) or "transformer" (new
+    # TPU-native long-context capability; on-policy algos only).
+    model: str = "lstm"
+    n_heads: int = 4
+    n_layers: int = 2
+    # Attention impl for the transformer: "full" | "ring" | "ulysses".
+    attention_impl: str = "full"
+    # Worker-side attention context (sliding window) for transformer acting;
+    # 0 = use seq_len.
+    act_ctx: int = 0
 
     # rollout
     time_horizon: int = 500
@@ -95,6 +105,9 @@ class Config:
     reset_carry_on_first: bool = True
     # Data-parallel mesh size for the learner (1 = single chip).
     mesh_data: int = 1
+    # Sequence-parallel mesh size (long-context training; needs
+    # model="transformer" and attention_impl "ring"/"ulysses").
+    mesh_seq: int = 1
     # Compute dtype for the train step ("float32" or "bfloat16").
     compute_dtype: str = "float32"
     # Worker step throttle, seconds (reference hard-codes 0.05:
@@ -136,6 +149,29 @@ class Config:
             "float32",
             "bfloat16",
         ), f"compute_dtype must be float32 or bfloat16, got {self.compute_dtype!r}"
+        assert self.model in ("lstm", "transformer"), self.model
+        assert self.attention_impl in ("full", "ring", "ulysses")
+        if self.mesh_seq > 1:
+            assert self.model == "transformer", (
+                "sequence parallelism (mesh_seq>1) requires model='transformer'"
+            )
+            assert self.attention_impl in ("ring", "ulysses")
+            assert self.seq_len % self.mesh_seq == 0, (
+                f"seq_len {self.seq_len} not divisible by mesh_seq {self.mesh_seq}"
+            )
+            if self.attention_impl == "ulysses":
+                assert self.n_heads % self.mesh_seq == 0, (
+                    f"ulysses needs n_heads ({self.n_heads}) divisible by "
+                    f"mesh_seq ({self.mesh_seq})"
+                )
+        if self.model == "transformer":
+            assert not is_off_policy(self.algo), (
+                "transformer backbone supports the on-policy algorithms"
+            )
+
+    @property
+    def effective_act_ctx(self) -> int:
+        return self.act_ctx or self.seq_len
 
     def replace(self, **kw: Any) -> "Config":
         new = dataclasses.replace(self, **kw)

@@ -41,11 +41,21 @@ class BatchLayout:
     def from_config(cls, cfg: Config) -> "BatchLayout":
         from tpu_rl.types import field_widths
 
+        obs_dim = int(np.prod(cfg.obs_shape))
+        hx_w = cx_w = None
+        if cfg.model == "transformer":
+            # Transformer training ignores the carry entirely, so the batch
+            # stores 1-float placeholders instead of shipping the worker's
+            # obs-history window over DCN/shm (the acting carry stays
+            # worker-local; see ModelFamily.carry_widths).
+            hx_w, cx_w = 1, 1
         widths = field_widths(
-            int(np.prod(cfg.obs_shape)),
+            obs_dim,
             int(cfg.action_space),
             cfg.hidden_size,
             cfg.is_continuous,
+            hx_width=hx_w,
+            cx_width=cx_w,
         )
         return cls(seq_len=cfg.seq_len, **widths)
 

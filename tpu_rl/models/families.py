@@ -54,6 +54,17 @@ class ModelFamily:
     act: Callable[..., tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]] = (
         field(repr=False, default=None)
     )
+    # Widths of the worker-side acting carry (h, c). LSTM: (hidden, hidden).
+    # Transformer: (obs-history window, step counter).
+    act_carry_widths: tuple[int, int] | None = None
+    # Whether the per-step carry must be stored into the batch (LSTM training
+    # inits from seq-step-0 states; transformers ignore the carry, so
+    # shipping it would waste DCN bandwidth and shm).
+    store_carry: bool = True
+
+    @property
+    def carry_widths(self) -> tuple[int, int]:
+        return self.act_carry_widths or (self.hidden, self.hidden)
 
     # -------------------------------------------------------------- builders
     def init_params(self, key: jax.Array, seq_len: int = 2) -> Params:
@@ -103,18 +114,79 @@ def _act_sac_discrete(actor: SACDiscreteActor, params, obs, h, c, key):
     return a[..., None].astype(jnp.float32), logits, log_prob[..., None], h2, c2
 
 
+def _act_transformer(actor, ctx: int, obs_dim: int, params, obs, h, c, key):
+    """Sliding-window acting for the transformer family.
+
+    The carry reuses the (hx, cx) plumbing: ``h`` is the flattened history of
+    the last ``ctx`` observations (newest last), ``c`` is a 1-float counter of
+    valid steps this episode. The worker zeroes both at episode starts, which
+    empties the window — no state crosses episodes. Inside an episode longer
+    than ``ctx`` the policy attends over the newest ``ctx`` steps.
+
+    Positions are episode-relative (0 at the episode start), matching the
+    training unroll's segment-relative positions, so behavior and training
+    policies agree exactly while an episode fits one window; beyond that the
+    sliding window truncates context the training unroll restarts — a
+    policy-lag-like bias absorbed by the IS/V-trace corrections."""
+    hist = h.reshape(1, ctx, obs_dim)
+    hist = jnp.concatenate([hist[:, 1:], obs[:, None, :]], axis=1)
+    n_valid = jnp.minimum(c[0, 0] + 1.0, float(ctx))
+    idx = jnp.arange(ctx)
+    # Invalid (pre-episode) rows get segment 0, valid rows segment 1: the
+    # query (last row) is always valid, so padding is masked out exactly.
+    seg = (idx >= ctx - n_valid.astype(jnp.int32))[None].astype(jnp.int32)
+    # Episode-relative positions: the oldest valid row is position 0 (or the
+    # sliding offset once the episode outgrows the window).
+    pos = jnp.maximum(idx - (ctx - n_valid.astype(jnp.int32)), 0)[None]
+    firsts = jnp.zeros((1, ctx, 1))
+    logits, _value, _ = actor.apply(
+        params["actor"], hist, None, firsts, pos=pos, seg=seg
+    )
+    last = logits[:, -1]
+    a = D.categorical_sample(key, last)
+    log_prob = D.categorical_log_prob(last, a)
+    h2 = hist.reshape(1, ctx * obs_dim)
+    c2 = jnp.full_like(c, n_valid)
+    return a[..., None].astype(jnp.float32), last, log_prob[..., None], h2, c2
+
+
 def _act_sac_continuous(actor: SACContinuousActor, params, obs, h, c, key):
     mu, log_std, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
     a, log_prob = D.tanh_normal_sample(key, mu, jnp.exp(log_std))
     return a, jnp.zeros_like(mu), log_prob, h2, c2
 
 
-def build_family(cfg: Config) -> ModelFamily:
+def build_family(cfg: Config, mesh=None) -> ModelFamily:
     """Build the model family for ``cfg.algo`` (registry equivalent of
-    ``main.py:98-110``)."""
+    ``main.py:98-110``). ``mesh`` is required only for sequence-parallel
+    transformer training (attention_impl ring/ulysses)."""
     obs_dim = int(cfg.obs_shape[0])
     n = int(cfg.action_space)
     kw = dict(hidden=cfg.hidden_size, reset_on_first=cfg.reset_carry_on_first)
+
+    if cfg.model == "transformer":
+        from tpu_rl.models.transformer import TransformerActorCritic
+
+        assert cfg.algo in ("PPO", "IMPALA", "V-MPO"), (
+            "transformer backbone supports the discrete on-policy algorithms"
+        )
+        actor = TransformerActorCritic(
+            n_actions=n,
+            hidden=cfg.hidden_size,
+            n_heads=cfg.n_heads,
+            n_layers=cfg.n_layers,
+            attention_impl=cfg.attention_impl,
+            mesh=mesh,
+        )
+        fam = ModelFamily(
+            cfg.algo, False, False, actor, None, obs_dim, n, cfg.hidden_size,
+            act=partial(
+                _act_transformer, actor, cfg.effective_act_ctx, obs_dim
+            ),
+            act_carry_widths=(cfg.effective_act_ctx * obs_dim, 1),
+            store_carry=False,
+        )
+        return fam
 
     if cfg.algo in ("PPO", "IMPALA", "V-MPO"):
         actor = DiscreteActorCritic(n_actions=n, **kw)

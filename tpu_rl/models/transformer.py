@@ -1,0 +1,166 @@
+"""Transformer actor-critic for long-context training.
+
+New TPU-native capability with no reference equivalent (the reference's only
+sequence model is a 5-step LSTM window, ``/root/reference/networks/models.py:71-75``;
+SURVEY.md §5.7 records sequence parallelism as absent). This module exposes the
+SAME unroll contract as ``DiscreteActorCritic`` —
+``(obs, carry0, firsts) -> (log-softmax logits, value, carry)`` — so the
+existing PPO / IMPALA / V-MPO train steps work unchanged with a transformer
+policy; the carry is accepted and returned untouched (attention needs no
+recurrent state).
+
+Long sequences shard over the mesh's ``"seq"`` axis: the attention primitive
+is ``shard_map``-wrapped ring attention (or Ulysses all-to-all) from
+``tpu_rl.parallel.sequence``, embedded inside the surrounding GSPMD program —
+XLA partitions the elementwise/Dense compute from the batch sharding while the
+ring rotates K/V blocks over ICI. Episode seams (``is_fir``) become attention
+segment masks, computed globally before sharding, so no token attends across
+an episode boundary.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpu_rl.parallel.sequence import (
+    ATTENTION_IMPLS,
+    DATA_AXIS,
+    SEQ_AXIS,
+    segment_ids_from_firsts,
+)
+
+
+def sinusoidal_embedding(pos: jax.Array, dim: int) -> jax.Array:
+    """(B, T) int positions -> (B, T, dim) sinusoidal embeddings. Parameter-
+    free, so context length is unbounded (no learned table to outgrow)."""
+    half = dim // 2
+    freqs = np.exp(-np.log(10000.0) * np.arange(half) / max(half - 1, 1))
+    ang = pos[..., None].astype(jnp.float32) * freqs  # (B, T, half)
+    emb = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 0), (0, 1)))
+    return emb
+
+
+class MultiHeadAttention(nn.Module):
+    """Causal segment-masked MHA with a pluggable (possibly sequence-sharded)
+    attention primitive."""
+
+    n_heads: int
+    attention_impl: str = "full"  # full | ring | ulysses
+    mesh: Any = None  # jax Mesh when impl is sharded
+
+    @nn.compact
+    def __call__(self, x: jax.Array, pos: jax.Array, seg: jax.Array):
+        B, T, C = x.shape
+        H = self.n_heads
+        assert C % H == 0, f"d_model {C} not divisible by heads {H}"
+        qkv = nn.Dense(3 * C, name="qkv")(x).reshape(B, T, 3, H, C // H)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        impl = ATTENTION_IMPLS[self.attention_impl]
+        # Shapes are static under tracing: only enter the shard_map island
+        # when they tile the mesh (param init traces with B=1; acting traces
+        # with T=ctx — both fall back to the mathematically identical full
+        # attention on a single device).
+        tiles_mesh = self.mesh is not None and (
+            B % self.mesh.shape[DATA_AXIS] == 0
+            and T % self.mesh.shape[SEQ_AXIS] == 0
+        )
+        if tiles_mesh and self.attention_impl != "full":
+            qs = P(DATA_AXIS, SEQ_AXIS, None, None)
+            ps = P(DATA_AXIS, SEQ_AXIS)
+            attn = jax.shard_map(
+                functools.partial(impl, axis_name=SEQ_AXIS, causal=True),
+                mesh=self.mesh,
+                in_specs=(qs, qs, qs, ps, ps),
+                out_specs=qs,
+            )
+            o = attn(q, k, v, pos, seg)
+        else:
+            from tpu_rl.parallel.sequence import full_attention
+
+            o = full_attention(q, k, v, pos, seg, causal=True)
+        return nn.Dense(C, name="out")(o.reshape(B, T, C))
+
+
+class Block(nn.Module):
+    n_heads: int
+    ff_mult: int = 4
+    attention_impl: str = "full"
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, pos, seg):
+        a = MultiHeadAttention(
+            self.n_heads, self.attention_impl, self.mesh, name="attn"
+        )(nn.LayerNorm(name="ln1")(x), pos, seg)
+        x = x + a
+        h = nn.LayerNorm(name="ln2")(x)
+        h = nn.Dense(self.ff_mult * x.shape[-1], name="ff1")(h)
+        h = nn.Dense(x.shape[-1], name="ff2")(nn.gelu(h))
+        return x + h
+
+
+class TransformerActorCritic(nn.Module):
+    """Decoder-only causal transformer with categorical + value heads.
+
+    Same unroll contract as ``DiscreteActorCritic.unroll``; ``carry0`` is
+    passed through untouched so the LSTM-shaped plumbing (batch hx/cx fields,
+    worker carries) keeps working."""
+
+    n_actions: int
+    hidden: int = 64  # d_model; reuses cfg.hidden_size
+    n_heads: int = 4
+    n_layers: int = 2
+    ff_mult: int = 4
+    attention_impl: str = "full"
+    mesh: Any = None
+    reset_on_first: bool = True  # interface parity; attention always resets
+    # via segment masking (a transformer cannot "carry state across seams")
+
+    @nn.compact
+    def __call__(
+        self,
+        obs: jax.Array,
+        carry0,
+        firsts: jax.Array,
+        pos: jax.Array | None = None,
+        seg: jax.Array | None = None,
+    ):
+        B, T = obs.shape[0], obs.shape[1]
+        if seg is None:
+            # Global cumsum: correct under jit/GSPMD (sharding is invisible
+            # to program semantics); shard_map callers must pass seg shards.
+            seg = segment_ids_from_firsts(firsts)
+        if pos is None:
+            # Segment-relative positions (restart at episode seams): keeps
+            # training positions consistent with the worker's acting
+            # positions, which count from the episode start.
+            idx = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+            seam = jax.lax.cummax(
+                jnp.where(firsts[..., 0] > 0, idx, 0), axis=1
+            )
+            pos = idx - seam
+        x = nn.Dense(self.hidden, name="embed")(obs)
+        x = x + sinusoidal_embedding(pos, self.hidden)
+        for i in range(self.n_layers):
+            x = Block(
+                self.n_heads,
+                self.ff_mult,
+                self.attention_impl,
+                self.mesh,
+                name=f"block{i}",
+            )(x, pos, seg)
+        h = nn.LayerNorm(name="ln_f")(x)
+        logits = jax.nn.log_softmax(nn.Dense(self.n_actions, name="logits")(h))
+        value = nn.Dense(1, name="value")(h)
+        return logits, value, carry0
+
+    unroll = __call__

@@ -1,4 +1,5 @@
-"""Mesh-parallel learner utilities (SURVEY.md §7 step 5)."""
+"""Mesh-parallel learner utilities (SURVEY.md §7 step 5) and the
+sequence/context-parallel long-context subsystem."""
 
 from tpu_rl.parallel.mesh import (
     DATA_AXIS,
@@ -7,15 +8,35 @@ from tpu_rl.parallel.mesh import (
     make_mesh,
     replicated,
 )
-from tpu_rl.parallel.dp import make_parallel_train_step, replicate, shard_batch
+from tpu_rl.parallel.dp import (
+    make_parallel_train_step,
+    make_sp_train_step,
+    replicate,
+    shard_batch,
+)
+from tpu_rl.parallel.sequence import (
+    SEQ_AXIS,
+    full_attention,
+    make_sp_mesh,
+    ring_attention,
+    segment_ids_from_firsts,
+    ulysses_attention,
+)
 
 __all__ = [
     "DATA_AXIS",
+    "SEQ_AXIS",
     "batch_sharding",
     "check_divisible",
     "make_mesh",
+    "make_sp_mesh",
     "replicated",
     "make_parallel_train_step",
+    "make_sp_train_step",
     "replicate",
     "shard_batch",
+    "full_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "segment_ids_from_firsts",
 ]

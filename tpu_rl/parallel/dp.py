@@ -44,6 +44,32 @@ def make_parallel_train_step(
     )
 
 
+def make_sp_train_step(train_step: Callable, mesh, cfg: Config | None = None):
+    """Compile a train step over a 2-D (data, seq) mesh: batch leaves are
+    sharded on BOTH leading dims — batch over ``"data"``, time over
+    ``"seq"`` — state/key replicated. The model's ring/Ulysses attention
+    (a shard_map island inside this GSPMD program) keeps K/V sharded; the
+    cheap loss scans (GAE/V-trace over (B, T) scalars) are resharded by XLA
+    as needed. This is the long-context training entry point."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_rl.parallel.sequence import DATA_AXIS, SEQ_AXIS
+
+    if cfg is not None:
+        if cfg.batch_size % mesh.shape[DATA_AXIS] != 0:
+            raise ValueError("batch_size not divisible by data axis")
+        if cfg.seq_len % mesh.shape[SEQ_AXIS] != 0:
+            raise ValueError("seq_len not divisible by seq axis")
+    bs = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    rs = NamedSharding(mesh, P())
+    return jax.jit(
+        train_step,
+        in_shardings=(rs, bs, rs),
+        out_shardings=(rs, rs),
+        donate_argnums=(0,),
+    )
+
+
 def shard_batch(batch: Batch, mesh) -> Batch:
     """Host numpy/jax batch -> device-sharded batch (each chip gets its slice
     of the leading dim). This is the HOST->DEVICE boundary the reference

@@ -1,0 +1,211 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no attention and no sequence parallelism of any kind
+(SURVEY.md §5.7 — its "sequence" is a 5-step LSTM window). This module is the
+TPU-native long-context subsystem: train on sequences far longer than one
+chip's HBM by sharding the time dimension across a ``"seq"`` mesh axis.
+
+Two standard schemes, both exact (not approximations):
+
+- **Ring attention** (`ring_attention`): queries stay put; K/V blocks rotate
+  around the ring via ``jax.lax.ppermute``, one neighbor hop per step, while
+  a flash-style online softmax (running max + normalizer) accumulates the
+  exact attention output. Memory per chip is O(T/n); the K/V transfer rides
+  ICI and overlaps with the block matmuls.
+- **Ulysses all-to-all** (`ulysses_attention`): ``all_to_all`` re-shards from
+  sequence-sharded to head-sharded, runs full-sequence attention on each
+  chip's head subset, then re-shards back. Cheaper collectives for moderate
+  T; requires heads % n == 0.
+
+Both take explicit global *positions* and *segment ids* so causal masking and
+episode-boundary resets (``is_fir`` seams, the RL analog of document masking)
+stay correct under sharding — segment ids are computed once, globally, by the
+caller (a cumsum over ``is_fir``) and sharded alongside Q/K/V.
+
+Used inside ``shard_map`` with the mesh from :func:`make_sp_mesh`; wrapped
+for end users by ``tpu_rl.models.transformer`` and the long-context train
+step. All ops are differentiable (``ppermute``/``all_to_all`` have exact
+transposes), so one ``jax.grad`` of the wrapped loss backpropagates through
+the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+_NEG_INF = -1e30  # finite -inf stand-in: keeps exp()/max() NaN-free
+
+
+def make_sp_mesh(n_data: int, n_seq: int, devices=None) -> Mesh:
+    """2-D (data, seq) mesh. Sequence ring hops are between mesh neighbors,
+    so keep the seq axis minor (fastest-varying) — on TPU that maps the ring
+    onto adjacent ICI links."""
+    devs = list(devices) if devices is not None else jax.devices()
+    need = n_data * n_seq
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    grid = np.asarray(devs[:need]).reshape(n_data, n_seq)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS))
+
+
+# --------------------------------------------------------------------- core
+def _masked_block_scores(q, k, q_pos, k_pos, q_seg, k_seg, scale, causal):
+    """(B, H, Tq, Tk) masked logits for one Q-block/K-block pair."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = q_seg[:, None, :, None] == k_seg[:, None, None, :]
+    if causal:
+        mask &= q_pos[:, None, :, None] >= k_pos[:, None, None, :]
+    return jnp.where(mask, scores, _NEG_INF)
+
+
+def _online_update(o, m, l, scores, v_blk):
+    """Flash-attention online-softmax accumulation of one K/V block.
+    o: (B, Tq, H, D); m, l: (B, H, Tq); scores: (B, H, Tq, Tk)."""
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    alpha = jnp.exp(m - m_new)  # rescale of previous accumulators
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v_blk
+    )
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    seg: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args (all per-device shards):
+      q, k, v : (B, Tl, H, D)
+      q_pos   : (B, Tl) global positions of this shard's rows
+      seg     : (B, Tl) global segment ids (episode index) of this shard
+    Returns (B, Tl, H, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    B, Tl, H, D = q.shape
+    # Derive the accumulators from q so they carry q's device-varying type
+    # (shard_map's varying-axis tracking requires scan carries to keep a
+    # stable type across iterations).
+    o = q * 0.0
+    zero_bht = q.sum(axis=-1).transpose(0, 2, 1) * 0.0  # (B, H, Tl)
+    m = zero_bht + _NEG_INF
+    l = zero_bht
+    # Each ring step sees the K/V block originally owned by device
+    # (idx - step) mod n; its rows' global positions/segments travel with it.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, _):
+        o, m, l, k_blk, v_blk, k_pos, k_seg = carry
+        scores = _masked_block_scores(
+            q, k_blk, q_pos, k_pos, seg, k_seg, scale, causal
+        )
+        o, m, l = _online_update(o, m, l, scores, v_blk)
+        k_blk, v_blk, k_pos, k_seg = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm),
+            (k_blk, v_blk, k_pos, k_seg),
+        )
+        return (o, m, l, k_blk, v_blk, k_pos, k_seg), None
+
+    (o, m, l, *_), _ = jax.lax.scan(
+        body, (o, m, l, k, v, q_pos, seg), None, length=n
+    )
+    # Rows whose mask was empty everywhere (can't happen under causal
+    # self-attention — a row always sees itself) would have l == 0; guard
+    # anyway so non-causal edge cases stay finite.
+    l = jnp.maximum(l, 1e-30)
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    seg: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention via all-to-all head re-sharding (DeepSpeed-Ulysses
+    scheme). Same contract as :func:`ring_attention`; requires H % n == 0."""
+    n = jax.lax.psum(1, axis_name)
+    B, Tl, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+
+    def to_heads(x):
+        # (B, Tl, H, D) seq-sharded -> (B, n*Tl, H/n, D) head-sharded: tiled
+        # all_to_all splits the head axis into n chunks (chunk j to device j)
+        # and concatenates received sequence blocks in device order, i.e.
+        # global sequence order.
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    # positions/segments: gather the full sequence (small: B x T ints).
+    pos_full = _all_gather_seq(q_pos, axis_name)
+    seg_full = _all_gather_seq(seg, axis_name)
+
+    scores = _masked_block_scores(
+        qh, kh, pos_full, pos_full, seg_full, seg_full, scale, causal
+    )
+    p = jax.nn.softmax(scores, axis=-1)
+    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+
+    # back: (B, n*Tl, H/n, D) -> (B, Tl, H, D), the exact inverse exchange.
+    return jax.lax.all_to_all(
+        oh, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def _all_gather_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """(B, Tl) -> (B, T) concatenated in ring order."""
+    g = jax.lax.all_gather(x, axis_name, axis=1)  # (B, n, Tl)
+    return g.reshape(x.shape[0], -1)
+
+
+def full_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    seg: jax.Array,
+    axis_name: str | None = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Single-device reference implementation (same contract, no sharding).
+    This is also the implementation the transformer uses when no seq mesh is
+    in scope."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = _masked_block_scores(q, k, q_pos, q_pos, seg, seg, scale, causal)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+ATTENTION_IMPLS = {
+    "full": full_attention,
+    "ring": ring_attention,
+    "ulysses": ulysses_attention,
+}
+
+
+def segment_ids_from_firsts(firsts: jax.Array) -> jax.Array:
+    """Global segment ids from episode-first flags: (B, T, 1) -> (B, T).
+    Computed on the FULL sequence before sharding so seams are correct
+    across shard boundaries."""
+    return jnp.cumsum(firsts[..., 0].astype(jnp.int32), axis=1)

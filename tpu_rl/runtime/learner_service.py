@@ -70,8 +70,15 @@ class LearnerService:
         off_policy = is_off_policy(cfg.algo)
         rng = np.random.default_rng(self.seed)
 
+        # Compile target meshes first: the family needs the mesh when the
+        # transformer's ring/Ulysses attention is sequence-sharded.
+        mesh = None
+        if cfg.mesh_seq > 1:
+            from tpu_rl.parallel import make_sp_mesh
+
+            mesh = make_sp_mesh(cfg.mesh_data, cfg.mesh_seq)
         family, state, train_step = get_algo(cfg.algo).build(
-            cfg, jax.random.key(self.seed)
+            cfg, jax.random.key(self.seed), mesh=mesh
         )
 
         # ---- checkpoint resume (newest index wins, SURVEY.md §5.4) ----
@@ -84,8 +91,13 @@ class LearnerService:
                 state, start_idx = restored
                 print(f"[learner] resumed from checkpoint idx {start_idx}")
 
-        # ---- compile: single-chip jit or GSPMD data-parallel mesh ----
-        if cfg.mesh_data > 1:
+        # ---- compile: single-chip jit, data-parallel, or data x seq mesh ----
+        if mesh is not None:
+            from tpu_rl.parallel.dp import make_sp_train_step, replicate
+
+            train_step = make_sp_train_step(train_step, mesh, cfg)
+            state = replicate(state, mesh)
+        elif cfg.mesh_data > 1:
             from tpu_rl.parallel.dp import make_parallel_train_step, replicate
             from tpu_rl.parallel.mesh import make_mesh
 

@@ -72,8 +72,18 @@ class Worker:
         act = jax.jit(family.act)
 
         env = EnvAdapter(cfg, seed=self.seed * 131 + self.worker_id)
-        h = jnp.zeros((1, cfg.hidden_size))
-        c = jnp.zeros((1, cfg.hidden_size))
+        # Acting carry shapes come from the family (LSTM: hidden states;
+        # transformer: obs-history window + counter); batch storage widths
+        # come from the layout and may be placeholders when the carry is
+        # worker-local (family.store_carry False).
+        from tpu_rl.data.layout import BatchLayout
+
+        lay = BatchLayout.from_config(cfg)
+        hw, cw = family.carry_widths
+        h = jnp.zeros((1, hw))
+        c = jnp.zeros((1, cw))
+        hx_stub = np.zeros((lay.hx,), np.float32)
+        cx_stub = np.zeros((lay.cx,), np.float32)
         obs = env.reset()
         episode_id = uuid.uuid4().hex
         is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
@@ -102,8 +112,8 @@ class Worker:
                     logits=np.asarray(logits[0]),
                     log_prob=np.asarray(log_prob[0]),
                     is_fir=np.asarray([is_fir], np.float32),
-                    hx=np.asarray(h[0]),
-                    cx=np.asarray(c[0]),
+                    hx=np.asarray(h[0]) if family.store_carry else hx_stub,
+                    cx=np.asarray(c[0]) if family.store_carry else cx_stub,
                     id=episode_id,
                     done=bool(done or horizon_hit),
                 )

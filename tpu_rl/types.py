@@ -16,13 +16,21 @@ BATCH_FIELDS = ("obs", "act", "rew", "logits", "log_prob", "is_fir", "hx", "cx")
 
 
 def field_widths(
-    obs_dim: int, action_space: int, hidden: int, continuous: bool
+    obs_dim: int,
+    action_space: int,
+    hidden: int,
+    continuous: bool,
+    hx_width: int | None = None,
+    cx_width: int | None = None,
 ) -> dict[str, int]:
     """Canonical feature width of every batch field — THE single source of
     truth shared by host buffers (``data.layout.BatchLayout``) and device
     shapes (``Batch.zeros``). Discrete actions/log-probs are width-1 float
     columns (reference convention,
-    ``/root/reference/agents/storage_module/shared_batch.py:28-31``)."""
+    ``/root/reference/agents/storage_module/shared_batch.py:28-31``).
+    ``hx_width``/``cx_width`` override the LSTM default for model families
+    with a different acting carry (transformer: obs-history window +
+    step counter)."""
     wide = action_space if continuous else 1
     return dict(
         obs=obs_dim,
@@ -31,8 +39,8 @@ def field_widths(
         logits=action_space,
         log_prob=wide,
         is_fir=1,
-        hx=hidden,
-        cx=hidden,
+        hx=hidden if hx_width is None else hx_width,
+        cx=hidden if cx_width is None else cx_width,
     )
 
 
@@ -86,11 +94,18 @@ class Batch:
         hidden: int,
         continuous: bool = False,
         dtype=jnp.float32,
+        hx_width: int | None = None,
+        cx_width: int | None = None,
     ) -> "Batch":
         import numpy as _np
 
         widths = field_widths(
-            int(_np.prod(obs_shape)), action_space, hidden, continuous
+            int(_np.prod(obs_shape)),
+            action_space,
+            hidden,
+            continuous,
+            hx_width=hx_width,
+            cx_width=cx_width,
         )
         z = lambda *sh: jnp.zeros((batch, seq, *sh), dtype)
         return cls(
