@@ -1,0 +1,113 @@
+"""Fused Pallas LSTM kernel: forward + gradient equivalence against the
+lax.scan path, in interpreter mode on CPU (real-TPU execution is covered by
+bench.py / __graft_entry__ on hardware)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_rl.models import cells
+from tpu_rl.models.cells import LSTMCell
+
+
+@pytest.fixture
+def lstm_setup(rng):
+    B, S, IN, H = 4, 6, 5, 16
+    cell = LSTMCell(H)
+    x = jnp.asarray(rng.normal(size=(B, S, IN)).astype(np.float32))
+    firsts = np.zeros((B, S, 1), np.float32)
+    firsts[:, 0] = 1.0
+    firsts[1, 3] = 1.0  # mid-sequence reset in one row
+    firsts = jnp.asarray(firsts)
+    h0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32))
+    c0 = jnp.asarray(rng.normal(size=(B, H)).astype(np.float32))
+    params = cell.init(jax.random.key(0), (h0, c0), x[:, 0])
+    return cell, params, x, firsts, (h0, c0)
+
+
+def _unroll(cell, params, x, carry0, firsts, reset=True):
+    return cell.apply(
+        params, x, carry0, firsts, reset, method=LSTMCell.unroll
+    )
+
+
+@pytest.mark.parametrize("reset", [True, False])
+def test_kernel_matches_scan_forward(lstm_setup, reset):
+    cell, params, x, firsts, carry0 = lstm_setup
+    cells.set_pallas_mode("off")
+    try:
+        (hf, cf), hs_scan = _unroll(cell, params, x, carry0, firsts, reset)
+        cells.set_pallas_mode("interpret")
+        (hk, ck), hs_kern = _unroll(cell, params, x, carry0, firsts, reset)
+    finally:
+        cells.set_pallas_mode("auto")
+    np.testing.assert_allclose(np.asarray(hs_kern), np.asarray(hs_scan), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hk), np.asarray(hf), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ck), np.asarray(cf), atol=1e-5)
+
+
+def test_kernel_gradients_match_scan(lstm_setup):
+    cell, params, x, firsts, carry0 = lstm_setup
+
+    def loss(params, x, carry0, mode):
+        cells.set_pallas_mode(mode)
+        try:
+            (hN, cN), hs = _unroll(cell, params, x, carry0, firsts, True)
+        finally:
+            cells.set_pallas_mode("auto")
+        # touch everything: per-step outputs and both finals
+        return (hs**2).sum() + (hN * 0.5).sum() + (cN * 0.25).sum()
+
+    g_scan = jax.grad(loss, argnums=(0, 1, 2))(params, x, carry0, "off")
+    g_kern = jax.grad(loss, argnums=(0, 1, 2))(params, x, carry0, "interpret")
+    flat_s = jax.tree_util.tree_leaves(g_scan)
+    flat_k = jax.tree_util.tree_leaves(g_kern)
+    assert len(flat_s) == len(flat_k)
+    for a, b in zip(flat_k, flat_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_full_train_step_with_kernel(rng):
+    """End-to-end: the PPO train step runs with the kernel active and matches
+    the scan path numerically."""
+    from tests.conftest import small_config
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.types import Batch
+
+    cfg = small_config()
+    _fam, state, train_step = get_algo("PPO").build(cfg, jax.random.key(0))
+    zb = Batch.zeros(
+        cfg.batch_size, cfg.seq_len, cfg.obs_shape, cfg.action_space,
+        cfg.hidden_size,
+    )
+    batch = zb.replace(
+        obs=jnp.asarray(
+            rng.normal(size=zb.obs.shape).astype(np.float32)
+        ),
+        act=jnp.asarray(
+            rng.integers(0, 2, size=zb.act.shape).astype(np.float32)
+        ),
+        log_prob=jnp.full(zb.log_prob.shape, -0.69),
+    )
+    key = jax.random.key(1)
+    cells.set_pallas_mode("off")
+    try:
+        s1, m1 = train_step(state, batch, key)
+        cells.set_pallas_mode("interpret")
+        s2, m2 = train_step(state, batch, key)
+    finally:
+        cells.set_pallas_mode("auto")
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params),
+        jax.tree_util.tree_leaves(s2.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_vmem_budget_fallback():
+    from tpu_rl.ops.pallas_lstm import fits_vmem
+
+    assert fits_vmem(128, 5, 64)
+    assert not fits_vmem(128, 4096, 256)  # long-context: transformer's job

@@ -1,4 +1,20 @@
-"""Recurrent cells."""
+"""Recurrent cells.
+
+The LSTM core of the reference model zoo
+(``/root/reference/networks/models.py:25-27``), re-architected for the MXU:
+
+- the input projection for a whole sequence is ONE batched (B*S, in) x
+  (in, 4H) matmul instead of a per-step concat matmul;
+- the sequential part carries only the small (B, H) x (H, 4H) recurrent
+  matmul, as a ``lax.scan`` — or, on TPU, as the fused Pallas kernel
+  (``tpu_rl.ops.pallas_lstm``) that keeps the recurrent weights VMEM-resident
+  for the entire sequence.
+
+Kernel dispatch is controlled by :func:`set_pallas_mode`:
+``"auto"`` (default) uses the kernel on TPU backends when the tile fits VMEM,
+``"interpret"`` forces the kernel in interpreter mode (CPU tests),
+``"off"`` always uses the scan.
+"""
 
 from __future__ import annotations
 
@@ -8,27 +24,122 @@ import jax.numpy as jnp
 
 Carry = tuple[jax.Array, jax.Array]
 
+_PALLAS_MODE = "auto"  # "auto" | "interpret" | "off"
+
+
+def set_pallas_mode(mode: str) -> None:
+    assert mode in ("auto", "interpret", "off"), mode
+    global _PALLAS_MODE
+    _PALLAS_MODE = mode
+
+
+def _use_pallas(batch: int, seq: int, hidden: int) -> tuple[bool, bool]:
+    """-> (use_kernel, interpret)."""
+    from tpu_rl.ops.pallas_lstm import fits_vmem
+
+    if _PALLAS_MODE == "off":
+        return False, False
+    if _PALLAS_MODE == "interpret":
+        # Explicit test/debug override: always exercise the kernel (the
+        # interpreter has no VMEM), so equivalence tests can never silently
+        # degrade into scan-vs-scan.
+        return True, True
+    if not fits_vmem(batch, seq, hidden):
+        return False, False
+    # The Mosaic custom call has no SPMD partitioning rule yet, so only use
+    # the kernel when this process drives a single device — the multi-chip
+    # train steps (make_parallel_train_step / make_sp_train_step) run the
+    # scan path, which GSPMD shards freely. TODO(next round): shard_map
+    # wrapper over the data axis so DP meshes keep the fused kernel.
+    return (
+        jax.default_backend() == "tpu" and len(jax.devices()) == 1,
+        False,
+    )
+
 
 class LSTMCell(nn.Module):
-    """A standard LSTM cell with torch ``nn.LSTMCell`` gate semantics
-    (i, f, g, o; ``c' = f*c + i*g``; ``h' = o*tanh(c')``) — the recurrent core
-    the whole reference model zoo is built on
-    (``/root/reference/networks/models.py:25-27``).
+    """Standard LSTM with torch ``nn.LSTMCell`` gate semantics
+    (i, f, g, o; ``c' = sig(f)*c + sig(i)*tanh(g)``; ``h' = sig(o)*tanh(c')``).
 
-    One fused Dense over ``[x, h]`` produces all four gates, so the per-step
-    compute is a single (in+H, 4H) matmul that XLA maps onto the MXU.
+    Exposes single-step ``__call__`` (worker act path) and full-sequence
+    ``unroll`` (training path) over one parameter set: ``x_proj`` (input
+    projection + bias) and ``recurrent_kernel`` (H, 4H).
     """
 
     hidden: int
 
-    @nn.compact
-    def __call__(self, carry: Carry, x: jax.Array) -> tuple[Carry, jax.Array]:
-        h, c = carry
-        z = nn.Dense(4 * self.hidden, name="gates")(jnp.concatenate([x, h], axis=-1))
-        i, f, g, o = jnp.split(z, 4, axis=-1)
+    def setup(self):
+        self.x_proj = nn.Dense(4 * self.hidden, name="x_proj")
+        self.recurrent_kernel = self.param(
+            "recurrent_kernel",
+            nn.initializers.lecun_normal(),
+            (self.hidden, 4 * self.hidden),
+        )
+
+    def _gates(self, z: jax.Array, c: jax.Array) -> tuple[jax.Array, jax.Array]:
+        H = self.hidden
+        i, f, g, o = (
+            z[..., :H],
+            z[..., H : 2 * H],
+            z[..., 2 * H : 3 * H],
+            z[..., 3 * H :],
+        )
         c2 = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
         h2 = nn.sigmoid(o) * jnp.tanh(c2)
+        return h2, c2
+
+    def __call__(self, carry: Carry, x: jax.Array) -> tuple[Carry, jax.Array]:
+        h, c = carry
+        z = self.x_proj(x) + h @ self.recurrent_kernel
+        h2, c2 = self._gates(z, c)
         return (h2, c2), h2
+
+    def unroll(
+        self,
+        x: jax.Array,
+        carry0: Carry,
+        firsts: jax.Array,
+        reset_on_first: bool,
+    ) -> tuple[Carry, jax.Array]:
+        """x (B, S, in), carry0 ((B,H),(B,H)), firsts (B, S, 1) ->
+        (final carry, hs (B, S, H))."""
+        B, S = x.shape[0], x.shape[1]
+        xp = self.x_proj(x)  # one big MXU matmul for every timestep
+        keep = (
+            1.0 - firsts[..., 0]
+            if reset_on_first
+            else jnp.ones((B, S), x.dtype)
+        )
+
+        use_kernel, interpret = _use_pallas(B, S, self.hidden)
+        if use_kernel:
+            from tpu_rl.ops.pallas_lstm import lstm_unroll
+
+            hs, cs = lstm_unroll(
+                xp.astype(jnp.float32),
+                self.recurrent_kernel.astype(jnp.float32),
+                carry0[0].astype(jnp.float32),
+                carry0[1].astype(jnp.float32),
+                keep.astype(jnp.float32),
+                interpret,
+            )
+            return (hs[:, -1], cs[:, -1]), hs
+
+        def step(carry, xs):
+            h, c = carry
+            xp_t, keep_t = xs
+            h = h * keep_t[:, None]
+            c = c * keep_t[:, None]
+            z = xp_t + h @ self.recurrent_kernel
+            h2, c2 = self._gates(z, c)
+            return (h2, c2), h2
+
+        carry, hs = jax.lax.scan(
+            step,
+            carry0,
+            (jnp.moveaxis(xp, 1, 0), jnp.moveaxis(keep, 1, 0)),
+        )
+        return carry, jnp.moveaxis(hs, 0, 1)
 
     @staticmethod
     def zero_carry(hidden: int, batch_shape: tuple[int, ...] = ()) -> Carry:

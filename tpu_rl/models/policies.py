@@ -39,25 +39,9 @@ def scan_lstm(
     """Unroll ``cell`` over the time axis (axis 1 of ``x``: (B, S, H)).
 
     ``firsts`` is (B, S, 1); when ``reset_on_first`` the carry is zeroed at
-    steps flagged as episode-first before the cell is applied.
-    """
-
-    def step(cell, carry, xs):
-        xt, ft = xs
-        if reset_on_first:
-            h, c = carry
-            keep = 1.0 - ft
-            carry = (h * keep, c * keep)
-        return cell(carry, xt)
-
-    scanner = nn.scan(
-        step,
-        variable_broadcast="params",
-        split_rngs={"params": False},
-        in_axes=1,
-        out_axes=1,
-    )
-    return scanner(cell, carry0, (x, firsts))
+    steps flagged as episode-first before the cell is applied. Dispatches to
+    the fused Pallas kernel on TPU (``tpu_rl.ops.pallas_lstm``)."""
+    return cell.unroll(x, carry0, firsts, reset_on_first)
 
 
 class DiscreteActorCritic(nn.Module):

@@ -1,0 +1,184 @@
+"""Fused LSTM sequence kernel (Pallas/TPU).
+
+The hot op of the reference model zoo is the LSTM unroll — a Python loop of
+``nn.LSTMCell`` launches in torch (``/root/reference/networks/models.py:71-75``),
+a ``lax.scan`` here. This kernel fuses the whole sequence into ONE Pallas
+program per batch tile: the recurrent weights live in VMEM for the entire
+sequence (zero re-fetch from HBM between timesteps), the per-step work is a
+single (Bt, H) x (H, 4H) MXU matmul plus VPU gate math, and the input
+projection for all timesteps is one big batched matmul done OUTSIDE the
+kernel where the MXU is happiest.
+
+Differentiation: ``lstm_unroll`` is a ``jax.custom_vjp`` — forward runs the
+Pallas kernel and saves the gate activations + cell states; backward is the
+analytic LSTM backprop as a reverse ``lax.scan`` (elementwise + two small
+matmuls per step), no recomputation.
+
+Episode resets: the carry is multiplied by ``keep = 1 - firsts[t]`` before
+each step, matching ``models.policies.scan_lstm`` semantics exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Max VMEM footprint for one batch tile before we refuse (the LSTM families
+# use short windows; long-context training is the transformer's job).
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _make_kernel(save_acts: bool):
+    def kernel(xp_ref, wh_ref, h0_ref, c0_ref, keep_ref, hs_ref, cs_ref, *rest):
+        """One batch tile, full sequence, TIME-MAJOR layouts (the sequence
+        index is the untiled leading axis, so the dynamic per-step index never
+        touches a tiled sublane/lane dimension — a Mosaic requirement).
+
+        xp   : (S, Bt, 4H) precomputed input projection (+bias)
+        wh   : (H, 4H) recurrent weights (VMEM-resident all S steps)
+        h0,c0: (Bt, H) initial carry
+        keep : (S, Bt, 1) carry-keep mask (0 at episode-first steps)
+        hs,cs: (S, Bt, H) per-step hidden / cell states (outputs)
+        acts : (S, Bt, 4H) post-activation gates i,f,g,o — only in the
+               differentiated path (VJP residuals); the primal skips the
+               stores entirely (XLA cannot DCE an opaque custom call).
+        """
+        acts_ref = rest[0] if save_acts else None
+        S = xp_ref.shape[0]
+        H = wh_ref.shape[0]
+        wh = wh_ref[:]
+
+        def step(t, carry):
+            h, c = carry
+            keep = keep_ref[t]  # (Bt, 1)
+            h = h * keep
+            c = c * keep
+            z = xp_ref[t] + jnp.dot(h, wh, preferred_element_type=jnp.float32)
+            i = jax.nn.sigmoid(z[:, :H])
+            f = jax.nn.sigmoid(z[:, H : 2 * H])
+            g = jnp.tanh(z[:, 2 * H : 3 * H])
+            o = jax.nn.sigmoid(z[:, 3 * H :])
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            hs_ref[t] = h2
+            cs_ref[t] = c2
+            if acts_ref is not None:
+                # one full-width store (no partial-lane writes)
+                acts_ref[t] = jnp.concatenate([i, f, g, o], axis=-1)
+            return h2, c2
+
+        jax.lax.fori_loop(0, S, step, (h0_ref[:], c0_ref[:]))
+
+    return kernel
+
+
+def _pallas_forward(xp, wh, h0, c0, keep, interpret: bool, save_acts: bool):
+    """xp (B,S,4H), keep (B,S) -> (hs, cs[, acts]) in batch-major layout
+    (the kernel runs time-major internally)."""
+    B, S, H4 = xp.shape
+    H = H4 // 4
+    out_shapes = [
+        jax.ShapeDtypeStruct((S, B, H), jnp.float32),  # hs
+        jax.ShapeDtypeStruct((S, B, H), jnp.float32),  # cs
+    ]
+    if save_acts:
+        out_shapes.append(jax.ShapeDtypeStruct((S, B, H4), jnp.float32))
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        _make_kernel(save_acts),
+        out_shape=tuple(out_shapes),
+        in_specs=[vmem] * 5,
+        out_specs=(vmem,) * len(out_shapes),
+        interpret=interpret,
+    )(
+        jnp.moveaxis(xp, 1, 0),
+        wh,
+        h0,
+        c0,
+        jnp.moveaxis(keep, 1, 0)[..., None],
+    )
+    return tuple(jnp.moveaxis(o, 0, 1) for o in outs)
+
+
+def fits_vmem(batch: int, seq: int, hidden: int) -> bool:
+    # xp + acts dominate: 2 * B*S*4H floats, plus hs/cs and weights.
+    floats = batch * seq * hidden * (4 + 4 + 1 + 1) + hidden * 4 * hidden
+    return floats * 4 <= _VMEM_BUDGET_BYTES
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def lstm_unroll(xp, wh, h0, c0, keep, interpret=False):
+    """Fused LSTM over a sequence.
+
+    xp (B,S,4H) input projection incl. bias; wh (H,4H); h0/c0 (B,H);
+    keep (B,S) carry-keep mask. Returns (hs, cs), each (B,S,H)."""
+    hs, cs = _pallas_forward(xp, wh, h0, c0, keep, interpret, save_acts=False)
+    return hs, cs
+
+
+def _fwd(xp, wh, h0, c0, keep, interpret):
+    hs, cs, acts = _pallas_forward(
+        xp, wh, h0, c0, keep, interpret, save_acts=True
+    )
+    return (hs, cs), (xp, wh, h0, c0, keep, hs, cs, acts)
+
+
+def _bwd(interpret, res, ct):
+    xp, wh, h0, c0, keep, hs, cs, acts = res
+    dhs, dcs = ct
+    B, S, H = hs.shape
+
+    h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)  # (B,S,H)
+    c_prev = jnp.concatenate([c0[:, None], cs[:, :-1]], axis=1)
+
+    def step(carry, xs):
+        dh, dc, dwh = carry
+        # per-step slices, time-reversed
+        dh_out, dc_out, act, hp, cp, c_t, kp = xs
+        kp = kp[:, None]
+        i, f, g, o = jnp.split(act, 4, axis=-1)
+        hp_used = hp * kp
+        cp_used = cp * kp
+        dh_t = dh_out + dh
+        t_c2 = jnp.tanh(c_t)  # tanh of the saved cell state
+        do = dh_t * t_c2
+        dc_t = dc_out + dc + dh_t * o * (1.0 - t_c2 * t_c2)
+        di = dc_t * g
+        dg = dc_t * i
+        df = dc_t * cp_used
+        dz = jnp.concatenate(
+            [
+                di * i * (1.0 - i),
+                df * f * (1.0 - f),
+                dg * (1.0 - g * g),
+                do * o * (1.0 - o),
+            ],
+            axis=-1,
+        )  # (B, 4H)
+        dwh = dwh + hp_used.T @ dz
+        dh_prev = (dz @ wh.T) * kp
+        dc_prev = dc_t * f * kp
+        return (dh_prev, dc_prev, dwh), dz
+
+    xs = (
+        jnp.moveaxis(dhs, 1, 0)[::-1],
+        jnp.moveaxis(dcs, 1, 0)[::-1],
+        jnp.moveaxis(acts, 1, 0)[::-1],
+        jnp.moveaxis(h_prev, 1, 0)[::-1],
+        jnp.moveaxis(c_prev, 1, 0)[::-1],
+        jnp.moveaxis(cs, 1, 0)[::-1],
+        jnp.moveaxis(keep, 1, 0)[::-1],
+    )
+    zero = jnp.zeros((B, H), jnp.float32)
+    (dh0, dc0, dwh), dz_rev = jax.lax.scan(
+        step, (zero, zero, jnp.zeros_like(wh)), xs
+    )
+    dxp = jnp.moveaxis(dz_rev[::-1], 0, 1)  # (B, S, 4H)
+    return dxp, dwh, dh0, dc0, None
+
+
+lstm_unroll.defvjp(_fwd, _bwd)
