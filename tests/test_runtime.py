@@ -166,3 +166,25 @@ def test_execution_timer_scalars():
     s = t.scalars()
     assert s["learner-throughput-elapsed-mean-sec"] >= 0.01
     assert 0 < s["learner-throughput-transition-per-secs"] < 640 / 0.01
+
+
+@pytest.mark.timeout(120)
+def test_crash_writes_error_log(tmp_path):
+    """A crashing child leaves logs/<role>/error_log_*.txt (reference
+    SaveErrorLog parity, utils/utils.py:192-198)."""
+    from tpu_rl.runtime.runner import Supervisor
+
+    sup = Supervisor(log_root=str(tmp_path / "logs"), max_restarts=0)
+    sup.spawn("crasher", _crash_main, cpu_only=True)
+    c = sup.children[0]
+    c.proc.join(60)
+    assert c.proc.exitcode not in (0, None)
+    logdir = tmp_path / "logs" / "crasher"
+    files = list(logdir.glob("error_log_*.txt"))
+    assert files, list((tmp_path / "logs").rglob("*"))
+    assert "boom" in files[0].read_text()
+    sup.stop()
+
+
+def _crash_main(stop_event, heartbeat):
+    raise RuntimeError("boom")

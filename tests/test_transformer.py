@@ -129,6 +129,28 @@ class TestSequenceParallelTrainStep:
                 np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-4
             )
 
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_bf16_sp_train_step_runs(self, devices, rng, impl):
+        """bfloat16 compute composes with sharded attention (f32 softmax
+        accumulators keep the scan carry dtype-stable)."""
+        from tpu_rl.data.layout import BatchLayout
+        from tpu_rl.parallel import make_sp_mesh, make_sp_train_step
+
+        cfg = _tf_config(
+            attention_impl=impl, mesh_data=2, mesh_seq=4,
+            compute_dtype="bfloat16",
+        )
+        lay = BatchLayout.from_config(cfg)
+        batch = _random_batch(cfg, rng, lay.hx, lay.cx)
+        mesh = make_sp_mesh(2, 4)
+        _, state, step = get_algo("PPO").build(cfg, jax.random.key(0), mesh=mesh)
+        pstep = make_sp_train_step(step, mesh, cfg)
+        state, metrics = pstep(state, batch, jax.random.key(7))
+        assert np.isfinite(float(metrics["loss"]))
+        assert {str(l.dtype) for l in jax.tree_util.tree_leaves(state.params)} == {
+            "float32"
+        }
+
     def test_sp_validates_divisibility(self, devices):
         from tpu_rl.parallel import make_sp_mesh, make_sp_train_step
 

@@ -150,6 +150,13 @@ class Config:
             "bfloat16",
         ), f"compute_dtype must be float32 or bfloat16, got {self.compute_dtype!r}"
         assert self.model in ("lstm", "transformer"), self.model
+        if self.compute_dtype == "bfloat16":
+            # Only the transformer path is bf16-wired today; reject instead
+            # of silently running the LSTM families in float32.
+            assert self.model == "transformer", (
+                "compute_dtype='bfloat16' currently requires "
+                "model='transformer' (LSTM families run float32)"
+            )
         assert self.attention_impl in ("full", "ring", "ulysses")
         if self.mesh_seq > 1:
             assert self.model == "transformer", (

@@ -177,6 +177,7 @@ def build_family(cfg: Config, mesh=None) -> ModelFamily:
             n_layers=cfg.n_layers,
             attention_impl=cfg.attention_impl,
             mesh=mesh,
+            dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None,
         )
         fam = ModelFamily(
             cfg.algo, False, False, actor, None, obs_dim, n, cfg.hidden_size,

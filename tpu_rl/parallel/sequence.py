@@ -58,8 +58,12 @@ def make_sp_mesh(n_data: int, n_seq: int, devices=None) -> Mesh:
 
 # --------------------------------------------------------------------- core
 def _masked_block_scores(q, k, q_pos, k_pos, q_seg, k_seg, scale, causal):
-    """(B, H, Tq, Tk) masked logits for one Q-block/K-block pair."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    """(B, H, Tq, Tk) masked logits for one Q-block/K-block pair. Always
+    float32: bf16 inputs hit the MXU, accumulation stays full-precision
+    (the canonical TPU mixed-precision pattern)."""
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * jnp.float32(scale)
     mask = q_seg[:, None, :, None] == k_seg[:, None, None, :]
     if causal:
         mask &= q_pos[:, None, :, None] >= k_pos[:, None, None, :]
@@ -74,7 +78,7 @@ def _online_update(o, m, l, scores, v_blk):
     p = jnp.exp(scores - m_new[..., None])
     l_new = l * alpha + p.sum(axis=-1)
     o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v_blk
+        "bhqk,bkhd->bqhd", p, v_blk, preferred_element_type=jnp.float32
     )
     return o_new, m_new, l_new
 
@@ -101,9 +105,10 @@ def ring_attention(
     B, Tl, H, D = q.shape
     # Derive the accumulators from q so they carry q's device-varying type
     # (shard_map's varying-axis tracking requires scan carries to keep a
-    # stable type across iterations).
-    o = q * 0.0
-    zero_bht = q.sum(axis=-1).transpose(0, 2, 1) * 0.0  # (B, H, Tl)
+    # stable type across iterations), then hold them in float32: softmax
+    # stats and the output accumulate full-precision even for bf16 q/k/v.
+    o = (q * 0.0).astype(jnp.float32)
+    zero_bht = (q.sum(axis=-1).transpose(0, 2, 1) * 0.0).astype(jnp.float32)
     m = zero_bht + _NEG_INF
     l = zero_bht
     # Each ring step sees the K/V block originally owned by device
@@ -129,7 +134,7 @@ def ring_attention(
     # self-attention — a row always sees itself) would have l == 0; guard
     # anyway so non-causal edge cases stay finite.
     l = jnp.maximum(l, 1e-30)
-    return o / l.transpose(0, 2, 1)[..., None]
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(

@@ -72,12 +72,15 @@ class Child:
 @dataclass
 class Supervisor:
     """Owns the children of one role process; restart-on-silence is the
-    feature the reference disabled (``main.py:417-473``)."""
+    feature the reference disabled (``main.py:417-473``). Every child is
+    wrapped so a crash writes ``logs/<role>/error_log_<ts>.txt``
+    (``utils.errlog``) before the supervisor sees the nonzero exit."""
 
     ctx: Any = field(default_factory=lambda: mp.get_context("spawn"))
     heartbeat_timeout: float = HEARTBEAT_TIMEOUT
     startup_grace: float = STARTUP_GRACE
     max_restarts: int = 3
+    log_root: str = "logs"
     children: list[Child] = field(default_factory=list)
 
     def __post_init__(self):
@@ -87,10 +90,12 @@ class Supervisor:
     def spawn(
         self, name: str, target: Callable, *args, cpu_only: bool = True
     ) -> Child:
+        from tpu_rl.utils.errlog import role_entry
+
         hb = self.ctx.Value("d", time.time())
         child = Child(
             name=name,
-            target=target,
+            target=functools.partial(role_entry, target, name, self.log_root),
             args=(*args, self.stop_event, hb),
             proc=None,  # type: ignore[arg-type]
             heartbeat=hb,
