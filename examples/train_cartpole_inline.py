@@ -1,107 +1,18 @@
-"""Single-process end-to-end slice: PPO on CartPole-v1 through the public API.
+"""Single-process end-to-end slice: PPO on CartPole-v1 (regression anchor).
 
-This is SURVEY.md §7 step 3 — env loop + seq-5 assembly + jitted train step in
-one process, no ZMQ — and the regression anchor for the distributed runtime.
+Thin wrapper over the general ``examples/train_inline.py`` (any algo, any
+env). Kept under this name as the canonical smoke check.
 
 Run: JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/train_cartpole_inline.py
 """
 
 from __future__ import annotations
 
-import collections
-import time
-
-import gymnasium as gym
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from tpu_rl.algos.registry import get_algo
-from tpu_rl.config import Config
-from tpu_rl.types import BATCH_FIELDS, Batch
+from examples.train_inline import main as _main
 
 
 def main(updates: int = 250, algo: str = "PPO", seed: int = 0) -> float:
-    cfg = Config.from_dict(
-        dict(
-            algo=algo,
-            obs_shape=(4,),
-            action_space=2,
-            batch_size=32,
-            seq_len=5,
-            lr=3e-4,
-            entropy_coef=0.001,
-            reward_scale=0.1,
-            time_horizon=500,
-        )
-    )
-    family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(seed))
-    train_step = jax.jit(train_step)
-    act = jax.jit(family.act)
-
-    env = gym.make(cfg.env)
-    key = jax.random.key(seed + 1)
-    obs, _ = env.reset(seed=seed)
-    h = jnp.zeros((1, cfg.hidden_size))
-    c = jnp.zeros((1, cfg.hidden_size))
-    is_fir = 1.0
-    epi_rew, epi_steps = 0.0, 0
-    rewards = collections.deque(maxlen=50)
-
-    seq: list[dict] = []
-    ready: list[dict] = []
-    t0 = time.time()
-
-    for update in range(updates):
-        # ---- collect batch_size seq-5 windows on-policy ----
-        while len(ready) < cfg.batch_size:
-            key, sub = jax.random.split(key)
-            ob = jnp.asarray(obs, jnp.float32)[None]
-            a, logits, log_prob, h2, c2 = act(state.params, ob, h, c, sub)
-            a_env = int(a[0, 0])
-            nobs, rew, term, trunc, _ = env.step(a_env)
-            done = term or trunc
-            epi_rew += float(rew)
-            epi_steps += 1
-            seq.append(
-                dict(
-                    obs=np.asarray(ob[0]),
-                    act=np.asarray(a[0]),
-                    rew=np.array([float(rew) * cfg.reward_scale], np.float32),
-                    logits=np.asarray(logits[0]),
-                    log_prob=np.asarray(log_prob[0]),
-                    is_fir=np.array([is_fir], np.float32),
-                    hx=np.asarray(h[0]),
-                    cx=np.asarray(c[0]),
-                )
-            )
-            if len(seq) == cfg.seq_len:
-                ready.append(
-                    {k: np.stack([s[k] for s in seq]) for k in BATCH_FIELDS}
-                )
-                seq = []
-            is_fir = 0.0
-            obs, h, c = nobs, h2, c2
-            if done or epi_steps >= cfg.time_horizon:
-                rewards.append(epi_rew)
-                obs, _ = env.reset()
-                h = jnp.zeros_like(h)
-                c = jnp.zeros_like(c)
-                is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
-
-        batch = Batch.from_mapping(
-            {k: np.stack([t[k] for t in ready]) for k in BATCH_FIELDS}
-        )
-        ready = []
-        key, sub = jax.random.split(key)
-        state, metrics = train_step(state, batch, sub)
-        if (update + 1) % 25 == 0:
-            mean_rew = float(np.mean(rewards)) if rewards else float("nan")
-            print(
-                f"update {update+1:4d}  loss {float(metrics['loss']):+.4f}  "
-                f"mean-epi-rew {mean_rew:7.2f}  elapsed {time.time()-t0:5.1f}s"
-            )
-    return float(np.mean(rewards)) if rewards else 0.0
+    return _main(updates=updates, algo=algo, env_name="CartPole-v1", seed=seed)
 
 
 if __name__ == "__main__":

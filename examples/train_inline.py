@@ -1,0 +1,133 @@
+"""Single-process end-to-end slice for ANY of the six algorithms, through the
+public API: EnvAdapter env loop + seq-window assembly + jitted train step, no
+ZMQ. Works for discrete (CartPole) and continuous (Pendulum/MountainCarContinuous)
+envs — the reference's two showcase settings (``/root/reference/README.md``).
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/train_inline.py \
+      [--algo PPO] [--env CartPole-v1] [--updates 250]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_rl.algos.registry import get_algo
+from tpu_rl.config import Config
+from tpu_rl.runtime.env import EnvAdapter, probe_spaces
+from tpu_rl.types import BATCH_FIELDS, Batch
+
+
+def act_params(state):
+    """Acting parameter tree for either state flavor (SACState keeps the
+    actor separate; TrainState nests it under "actor")."""
+    if hasattr(state, "actor_params"):
+        return {"actor": state.actor_params}
+    return {"actor": state.params["actor"]}
+
+
+def main(
+    updates: int = 250,
+    algo: str = "PPO",
+    env_name: str = "CartPole-v1",
+    seed: int = 0,
+    batch_size: int = 32,
+    log_every: int = 25,
+) -> float:
+    cfg = probe_spaces(
+        Config.from_dict(
+            dict(
+                algo=algo,
+                env=env_name,
+                batch_size=batch_size,
+                seq_len=5,
+                lr=3e-4,
+                entropy_coef=0.001,
+                reward_scale=0.1,
+                time_horizon=500,
+            )
+        )
+    )
+    family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(seed))
+    train_step = jax.jit(train_step)
+    act = jax.jit(family.act)
+
+    env = EnvAdapter(cfg, seed=seed)
+    key = jax.random.key(seed + 1)
+    obs = env.reset()
+    hw, cw = family.carry_widths
+    h = jnp.zeros((1, hw))
+    c = jnp.zeros((1, cw))
+    is_fir = 1.0
+    epi_rew, epi_steps = 0.0, 0
+    rewards = collections.deque(maxlen=50)
+
+    seq: list[dict] = []
+    ready: list[dict] = []
+    t0 = time.time()
+
+    for update in range(updates):
+        while len(ready) < cfg.batch_size:
+            key, sub = jax.random.split(key)
+            ob = jnp.asarray(obs, jnp.float32)[None]
+            a, logits, log_prob, h2, c2 = act(act_params(state), ob, h, c, sub)
+            next_obs, rew, done = env.step(np.asarray(a[0]))
+            epi_rew += rew
+            epi_steps += 1
+            seq.append(
+                dict(
+                    obs=np.asarray(ob[0]),
+                    act=np.asarray(a[0]),
+                    rew=np.array([rew * cfg.reward_scale], np.float32),
+                    logits=np.asarray(logits[0]),
+                    log_prob=np.asarray(log_prob[0]),
+                    is_fir=np.array([is_fir], np.float32),
+                    hx=np.asarray(h[0]),
+                    cx=np.asarray(c[0]),
+                )
+            )
+            if len(seq) == cfg.seq_len:
+                ready.append(
+                    {k: np.stack([s[k] for s in seq]) for k in BATCH_FIELDS}
+                )
+                seq = []
+            is_fir = 0.0
+            obs, h, c = next_obs, h2, c2
+            if done or epi_steps >= cfg.time_horizon:
+                rewards.append(epi_rew)
+                obs = env.reset()
+                h = jnp.zeros_like(h)
+                c = jnp.zeros_like(c)
+                is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
+
+        batch = Batch.from_mapping(
+            {k: np.stack([t[k] for t in ready]) for k in BATCH_FIELDS}
+        )
+        ready = []
+        key, sub = jax.random.split(key)
+        state, metrics = train_step(state, batch, sub)
+        if (update + 1) % log_every == 0:
+            mean_rew = float(np.mean(rewards)) if rewards else float("nan")
+            print(
+                f"update {update+1:4d}  loss {float(metrics['loss']):+.4f}  "
+                f"mean-epi-rew {mean_rew:8.2f}  elapsed {time.time()-t0:5.1f}s"
+            )
+    env.close()
+    return float(np.mean(rewards)) if rewards else 0.0
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--algo", default="PPO")
+    p.add_argument("--env", default="CartPole-v1")
+    p.add_argument("--updates", type=int, default=250)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    final = main(args.updates, args.algo, args.env, args.seed)
+    print(f"final 50-game mean episode reward: {final:.1f}")

@@ -1,0 +1,95 @@
+"""EnvAdapter tests: preprocessing, conv path, action adaptation, space
+probing (reference ``env_maker.py`` + the disabled conv path,
+``utils/utils.py:201-226`` — enabled here)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.runtime.env import EnvAdapter, probe_spaces
+
+
+def test_probe_spaces_discrete():
+    cfg = probe_spaces(small_config(env="CartPole-v1"))
+    assert cfg.obs_shape == (4,)
+    assert cfg.action_space == 2
+    assert not cfg.is_continuous
+
+
+def test_probe_spaces_continuous():
+    cfg = probe_spaces(small_config(env="Pendulum-v1"))
+    assert cfg.obs_shape == (3,)
+    assert cfg.action_space == 1
+    assert cfg.is_continuous
+
+
+def test_discrete_roundtrip():
+    cfg = probe_spaces(small_config(env="CartPole-v1"))
+    env = EnvAdapter(cfg, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,) and obs.dtype == np.float32
+    obs2, rew, done = env.step(np.asarray([1.0]))
+    assert obs2.shape == (4,)
+    assert isinstance(rew, float) and isinstance(done, bool)
+    env.close()
+
+
+def test_continuous_action_shaping():
+    cfg = probe_spaces(small_config(env="Pendulum-v1"))
+    env = EnvAdapter(cfg, seed=0)
+    env.reset()
+    obs, rew, done = env.step(np.asarray([0.5], np.float32))
+    assert obs.shape == (3,)
+    env.close()
+
+
+class _FakeImageEnv:
+    """Minimal gymnasium-like image env for the conv path."""
+
+    class _Box:
+        shape = (60, 40, 3)
+
+    class _Disc:
+        n = 3
+
+    observation_space = _Box()
+    action_space = _Disc()
+
+    def reset(self, seed=None):
+        return np.random.randint(0, 255, (60, 40, 3)).astype(np.uint8), {}
+
+    def step(self, a):
+        obs = np.random.randint(0, 255, (60, 40, 3)).astype(np.uint8)
+        return obs, 1.0, False, False, {}
+
+    def close(self):
+        pass
+
+
+@pytest.mark.parametrize("gray", [False, True])
+def test_conv_preprocess_shapes(monkeypatch, gray):
+    cfg = small_config(
+        need_conv=True, height=32, width=32, is_gray=gray,
+    )
+    env = EnvAdapter.__new__(EnvAdapter)
+    env.cfg = cfg
+    env.env = _FakeImageEnv()
+    env._seed = None
+    env._continuous = False
+    env._act_space = _FakeImageEnv.action_space
+    obs = env.reset()
+    want = 32 * 32 * (1 if gray else 3)
+    assert obs.shape == (want,)
+    assert obs.dtype == np.float32
+    assert 0.0 <= obs.min() and obs.max() <= 1.0  # /255 normalization
+
+
+def test_probe_spaces_conv_accounts_for_preprocessing(monkeypatch):
+    import gymnasium as gym
+
+    monkeypatch.setattr(gym, "make", lambda name: _FakeImageEnv())
+    cfg = probe_spaces(
+        small_config(need_conv=True, height=32, width=32, is_gray=True)
+    )
+    assert cfg.obs_shape == (32 * 32,)
+    assert cfg.action_space == 3

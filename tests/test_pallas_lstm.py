@@ -106,6 +106,41 @@ def test_full_train_step_with_kernel(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_dp_mesh_shard_map_island(devices, rng):
+    """The kernel runs as a shard_map island inside the data-parallel jitted
+    train step (8-device mesh, interpret mode) and matches the scan path."""
+    from tests.conftest import small_config
+    from tests.test_parallel import _fake_batch
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.parallel import make_mesh, make_parallel_train_step, replicate, shard_batch
+
+    cfg = small_config(batch_size=16)
+    fam, state0, train_step = get_algo("PPO").build(cfg, jax.random.key(0))
+    batch = _fake_batch(cfg, fam)
+    key = jax.random.key(1)
+
+    cells.set_pallas_mode("off")
+    try:
+        s_ref, m_ref = jax.jit(train_step)(state0, batch, key)
+
+        cells.set_pallas_mode("interpret")
+        mesh = make_mesh(8)
+        pstep = make_parallel_train_step(train_step, mesh, cfg)
+        state = replicate(state0, mesh)
+        s_mesh, m_mesh = pstep(state, shard_batch(batch, mesh), replicate(key, mesh))
+    finally:
+        cells.set_pallas_mode("auto")
+        cells.set_data_mesh(None)
+    np.testing.assert_allclose(
+        float(m_ref["loss"]), float(m_mesh["loss"]), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_ref.params),
+        jax.tree_util.tree_leaves(s_mesh.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 def test_vmem_budget_fallback():
     from tpu_rl.ops.pallas_lstm import fits_vmem
 

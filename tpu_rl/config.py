@@ -97,6 +97,14 @@ class Config:
     # logging / checkpoints
     loss_log_interval: int = 50
     model_save_interval: int = 100
+    # XLA profiler trace export (the reference has timers but no trace
+    # export, SURVEY.md §5.1): when set, the learner captures a device
+    # profile of ~profile_steps updates once profile_start updates have
+    # completed in this run (resume-safe; the trace is closed on exit even
+    # if the run ends early). View with tensorboard or xprof.
+    profile_dir: str | None = None
+    profile_start: int = 10
+    profile_steps: int = 5
 
     # ---- TPU-native knobs (new capability; no reference equivalent) ----
     # Reset the LSTM carry at in-sequence episode seams (the reference does not:

@@ -25,6 +25,11 @@ import jax.numpy as jnp
 Carry = tuple[jax.Array, jax.Array]
 
 _PALLAS_MODE = "auto"  # "auto" | "interpret" | "off"
+# Data-parallel mesh registered by make_parallel_train_step: when set, the
+# Pallas kernel runs as a shard_map island over the mesh's "data" axis (each
+# device unrolls its local batch shard) instead of being disabled under GSPMD
+# (the Mosaic custom call has no automatic SPMD partitioning rule).
+_DATA_MESH = None
 
 
 def set_pallas_mode(mode: str) -> None:
@@ -33,8 +38,21 @@ def set_pallas_mode(mode: str) -> None:
     _PALLAS_MODE = mode
 
 
-def _use_pallas(batch: int, seq: int, hidden: int) -> tuple[bool, bool]:
-    """-> (use_kernel, interpret)."""
+def set_data_mesh(mesh) -> None:
+    """Register the learner's 1-D data mesh so LSTM unrolls trace the kernel
+    inside shard_map. Call before the parallel train step is first traced
+    (``parallel.dp.make_parallel_train_step`` does this); pass None to clear."""
+    global _DATA_MESH
+    _DATA_MESH = mesh
+
+
+def _use_pallas(
+    batch: int, seq: int, hidden: int, mesh_active: bool = False
+) -> tuple[bool, bool]:
+    """-> (use_kernel, interpret). ``batch`` is the per-device shard size;
+    ``mesh_active`` says THIS trace will wrap the kernel in shard_map (a
+    registered-but-unusable mesh, e.g. a non-divisible init trace, must NOT
+    count: an unwrapped Mosaic call cannot live in a multi-device program)."""
     from tpu_rl.ops.pallas_lstm import fits_vmem
 
     if _PALLAS_MODE == "off":
@@ -46,15 +64,11 @@ def _use_pallas(batch: int, seq: int, hidden: int) -> tuple[bool, bool]:
         return True, True
     if not fits_vmem(batch, seq, hidden):
         return False, False
-    # The Mosaic custom call has no SPMD partitioning rule yet, so only use
-    # the kernel when this process drives a single device — the multi-chip
-    # train steps (make_parallel_train_step / make_sp_train_step) run the
-    # scan path, which GSPMD shards freely. TODO(next round): shard_map
-    # wrapper over the data axis so DP meshes keep the fused kernel.
-    return (
-        jax.default_backend() == "tpu" and len(jax.devices()) == 1,
-        False,
-    )
+    if jax.default_backend() != "tpu":
+        return False, False
+    # Single device: plain pallas_call. Multi-device: only inside the
+    # shard_map island of this trace.
+    return len(jax.devices()) == 1 or mesh_active, False
 
 
 class LSTMCell(nn.Module):
@@ -111,18 +125,47 @@ class LSTMCell(nn.Module):
             else jnp.ones((B, S), x.dtype)
         )
 
-        use_kernel, interpret = _use_pallas(B, S, self.hidden)
+        mesh = _DATA_MESH
+        n_data = 1
+        if mesh is not None and _PALLAS_MODE in ("auto", "interpret"):
+            from tpu_rl.parallel.mesh import DATA_AXIS
+
+            n_data = mesh.shape.get(DATA_AXIS, 1)
+            if B % n_data != 0:
+                mesh, n_data = None, 1  # init/act traces: fall through
+        use_kernel, interpret = _use_pallas(
+            B // n_data, S, self.hidden, mesh_active=mesh is not None and n_data > 1
+        )
         if use_kernel:
             from tpu_rl.ops.pallas_lstm import lstm_unroll
 
-            hs, cs = lstm_unroll(
+            args = (
                 xp.astype(jnp.float32),
                 self.recurrent_kernel.astype(jnp.float32),
                 carry0[0].astype(jnp.float32),
                 carry0[1].astype(jnp.float32),
                 keep.astype(jnp.float32),
-                interpret,
             )
+            if mesh is not None and n_data > 1:
+                from jax.sharding import PartitionSpec as P
+
+                from tpu_rl.parallel.mesh import DATA_AXIS
+
+                def _local_unroll(xp_, wh_, h0_, c0_, keep_):
+                    return lstm_unroll(xp_, wh_, h0_, c0_, keep_, interpret)
+
+                bspec = P(DATA_AXIS)  # shard every operand's leading (batch) dim
+                hs, cs = jax.shard_map(
+                    _local_unroll,
+                    mesh=mesh,
+                    in_specs=(bspec, P(), bspec, bspec, bspec),
+                    out_specs=(bspec, bspec),
+                    # No collectives inside; pallas out_shapes carry no vma
+                    # annotations, so varying-axis checking must be off.
+                    check_vma=False,
+                )(*args)
+            else:
+                hs, cs = lstm_unroll(*args, interpret)
             return (hs[:, -1], cs[:, -1]), hs
 
         def step(carry, xs):

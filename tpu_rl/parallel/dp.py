@@ -33,9 +33,24 @@ def make_parallel_train_step(
     jit with DP shardings. Returns the compiled callable."""
     if cfg is not None:
         check_divisible(cfg.batch_size, mesh)
+
+    # Register the mesh ONLY while this step traces, so LSTM unrolls emit the
+    # fused Pallas kernel as a shard_map island over the data axis (the
+    # Mosaic call cannot be auto-partitioned by GSPMD) — without leaking the
+    # mesh into unrelated traces in the same process.
+    from tpu_rl.models import cells
+
+    def traced_step(state, batch, key):
+        prev = cells._DATA_MESH
+        cells.set_data_mesh(mesh)
+        try:
+            return train_step(state, batch, key)
+        finally:
+            cells.set_data_mesh(prev)
+
     bs, rs = batch_sharding(mesh), replicated(mesh)
     return jax.jit(
-        train_step,
+        traced_step,
         # Pytree-prefix shardings: state & key replicated, every batch leaf
         # sharded along its leading dim.
         in_shardings=(rs, bs, rs),

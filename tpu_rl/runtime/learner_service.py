@@ -118,6 +118,7 @@ class LearnerService:
         self._publish(pub, state)
 
         idx = start_idx
+        profiling = False
         try:
             while not self._stopped():
                 if self.max_updates is not None and idx - start_idx >= self.max_updates:
@@ -142,6 +143,16 @@ class LearnerService:
                         state, metrics = train_step(state, batch, sub_key)
                 idx += 1
 
+                if cfg.profile_dir is not None:
+                    # Window is relative to THIS run's updates (resume-safe).
+                    rel = idx - start_idx
+                    if not profiling and rel >= cfg.profile_start:
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling = True
+                    elif profiling and rel >= cfg.profile_start + cfg.profile_steps:
+                        jax.block_until_ready(metrics)
+                        jax.profiler.stop_trace()
+                        profiling = False
                 if idx % self.publish_interval == 0:
                     self._publish(pub, state)
                 if idx % cfg.loss_log_interval == 0:
@@ -155,6 +166,9 @@ class LearnerService:
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
         finally:
+            if profiling:
+                # Never leave a trace open (early exit / stop-event / crash).
+                jax.profiler.stop_trace()
             if ckpt is not None and idx > start_idx:
                 ckpt.save(state, idx)
                 ckpt.close()
