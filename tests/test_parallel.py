@@ -58,18 +58,22 @@ def test_dp_step_runs_on_8dev_mesh(algo):
         assert np.isfinite(np.asarray(v)).all()
 
 
-def test_dp_matches_single_device():
+@pytest.mark.parametrize("algo", ["PPO", "V-MPO", "SAC"])
+def test_dp_matches_single_device(algo):
     """Sharded-over-8 must be numerically equivalent (fp tolerance) to the
-    unsharded step: GSPMD only changes layout, not math."""
-    cfg = small_config(algo="PPO", batch_size=8)
-    family, state, train_step = get_algo("PPO").build(cfg, jax.random.key(0))
+    unsharded step: GSPMD only changes layout, not math. V-MPO is the hard
+    case — its top-half advantage selection reduces over the GLOBAL batch
+    (reference ``v_mpo/learning.py:60-64``), so GSPMD must insert cross-chip
+    exchanges for the sort; SAC exercises the separate-state flavor."""
+    cfg = small_config(algo=algo, batch_size=8)
+    family, state, train_step = get_algo(algo).build(cfg, jax.random.key(0))
     batch = _fake_batch(cfg, family)
     key = jax.random.key(1)
 
     ref_state, ref_metrics = jax.jit(train_step)(state, batch, key)
 
     mesh = make_mesh(8)
-    _, state2, _ = get_algo("PPO").build(cfg, jax.random.key(0))
+    _, state2, _ = get_algo(algo).build(cfg, jax.random.key(0))
     pstep = make_parallel_train_step(train_step, mesh, cfg)
     dp_state, dp_metrics = pstep(
         replicate(state2, mesh), shard_batch(batch, mesh), replicate(key, mesh)
@@ -78,11 +82,35 @@ def test_dp_matches_single_device():
     np.testing.assert_allclose(
         float(ref_metrics["loss"]), float(dp_metrics["loss"]), rtol=2e-4, atol=2e-5
     )
-    for a, b in zip(
-        jax.tree_util.tree_leaves(ref_state.params),
-        jax.tree_util.tree_leaves(dp_state.params),
-    ):
+    def leaves(s):
+        return jax.tree_util.tree_leaves(
+            s.params
+            if hasattr(s, "params")
+            else (s.actor_params, s.critic_params, s.target_critic_params,
+                  s.log_alpha)
+        )
+
+    for a, b in zip(leaves(ref_state), leaves(dp_state)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
+
+
+def test_host_local_batch_to_global_single_process(devices):
+    """On one host, host-local placement must equal plain shard_batch."""
+    from tpu_rl.parallel.multihost import host_local_batch_to_global, is_multihost
+    from tpu_rl.parallel.mesh import batch_sharding
+
+    assert not is_multihost()
+    cfg = small_config(algo="PPO", batch_size=16)
+    family, _, _ = get_algo("PPO").build(cfg, jax.random.key(0))
+    batch = _fake_batch(cfg, family)
+    mesh = make_mesh(8)
+    sharding = batch_sharding(mesh)
+    host_np = {"obs": np.asarray(batch.obs), "rew": np.asarray(batch.rew)}
+    placed = host_local_batch_to_global(host_np, sharding)
+    want = shard_batch(batch, mesh)
+    np.testing.assert_array_equal(np.asarray(placed["obs"]), np.asarray(want.obs))
+    np.testing.assert_array_equal(np.asarray(placed["rew"]), np.asarray(want.rew))
+    assert placed["obs"].sharding.is_equivalent_to(want.obs.sharding, 3)
 
 
 def test_batch_not_divisible_raises():

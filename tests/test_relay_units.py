@@ -1,0 +1,99 @@
+"""Unit tests for manager relay and storage bridging logic (the integration
+test covers the wiring; these pin the behaviors: drop-oldest backpressure,
+50-game stat windowing, stat mailbox relay, store-full requeue)."""
+
+import numpy as np
+
+from tests.conftest import small_config
+from tpu_rl.data.assembler import RolloutAssembler
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.data.shm_ring import alloc_handles, OnPolicyStore
+from tpu_rl.runtime.manager import Manager, RELAY_QUEUE_MAX, STAT_WINDOW
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.storage import LearnerStorage, STAT_SLOTS
+from tpu_rl.types import BATCH_FIELDS
+
+
+class FakePub:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, proto, payload):
+        self.sent.append((proto, payload))
+
+
+def _manager(cfg=None):
+    cfg = cfg or small_config()
+    return Manager(cfg, 0, "127.0.0.1", 0)
+
+
+class TestManager:
+    def test_rollout_queue_drops_oldest(self):
+        m = _manager()
+        pub = FakePub()
+        for i in range(RELAY_QUEUE_MAX + 10):
+            m._ingest(Protocol.Rollout, {"i": i}, pub)
+        assert len(m.queue) == RELAY_QUEUE_MAX
+        # the 10 oldest were shed (stale rollouts are least on-policy)
+        assert m.queue[0]["i"] == 10
+
+    def test_stat_window_publishes_mean_every_50(self):
+        m = _manager()
+        pub = FakePub()
+        for i in range(STAT_WINDOW * 2):
+            m._ingest(Protocol.Stat, float(i), pub)
+        assert len(pub.sent) == 2
+        proto, payload = pub.sent[0]
+        assert proto == Protocol.Stat
+        assert payload["n"] == STAT_WINDOW
+        assert payload["mean"] == np.mean(np.arange(50.0))
+        # second window is the NEWEST 50 (sliding deque)
+        assert pub.sent[1][1]["mean"] == np.mean(np.arange(50.0, 100.0))
+
+
+def _mk_window(layout, tag):
+    return {
+        f: np.full((layout.seq_len, layout.width(f)), tag, np.float32)
+        for f in BATCH_FIELDS
+    }
+
+
+class TestStorage:
+    def _storage(self, cfg):
+        layout = BatchLayout.from_config(cfg)
+        handles = alloc_handles(layout, cfg.batch_size)
+        import multiprocessing as mp
+
+        stat = mp.get_context("spawn").Array("f", STAT_SLOTS, lock=False)
+        st = LearnerStorage(cfg, handles, 0, stat_array=stat)
+        return st, layout, handles, stat
+
+    def test_stat_relay_accumulates_game_count(self):
+        cfg = small_config()
+        st, *_rest, stat = self._storage(cfg)
+        st._relay_stat({"mean": 123.0, "n": 50})
+        st._relay_stat({"mean": 150.0, "n": 50})
+        assert stat[0] == 100.0  # global game count accumulates
+        assert stat[1] == 150.0  # newest mean wins
+        assert stat[2] == 1.0  # activate flag set for the learner
+        stat[2] = 0.0  # learner clears
+        st._relay_stat(7.5)  # bare-float stats also accepted
+        assert stat[0] == 101.0 and stat[2] == 1.0
+
+    def test_flush_requeues_on_full_store(self):
+        cfg = small_config(batch_size=2)
+        st, layout, handles, _ = self._storage(cfg)
+        store = OnPolicyStore(handles, layout)
+        asm = RolloutAssembler(layout)
+        for tag in (1.0, 2.0, 3.0):
+            asm.ready.append(_mk_window(layout, tag))
+        st._flush(asm, store)
+        # store capacity 2: two windows landed, the third was REQUEUED
+        assert st.n_windows == 2
+        assert st.n_requeue_full == 1
+        assert len(asm.ready) == 1
+        assert asm.ready[0]["rew"][0, 0] == 3.0
+        # after the learner consumes, the requeued window flushes
+        assert store.consume() is not None
+        st._flush(asm, store)
+        assert st.n_windows == 3 and len(asm.ready) == 0

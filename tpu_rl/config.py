@@ -116,6 +116,10 @@ class Config:
     # Sequence-parallel mesh size (long-context training; needs
     # model="transformer" and attention_impl "ring"/"ulysses").
     mesh_seq: int = 1
+    # Multi-host learner: {"coordinator": "ip:port", "num_processes": N,
+    # "process_id": i}. After jax.distributed init, meshes span all hosts'
+    # chips and the same GSPMD train steps scale unchanged (parallel.multihost).
+    multihost: dict | None = None
     # Compute dtype for the train step ("float32" or "bfloat16").
     compute_dtype: str = "float32"
     # Worker step throttle, seconds (reference hard-codes 0.05:

@@ -1,0 +1,85 @@
+"""Multi-host learner initialization (ICI + DCN collectives).
+
+The reference's distributed story stops at ZMQ over TCP — it has no
+NCCL/MPI/collective backend at all (SURVEY.md §2.4). The TPU-native answer is
+the JAX runtime itself: after :func:`init_multihost` every host in a pod
+slice sees the GLOBAL device set, ``make_mesh``/``make_sp_mesh`` build meshes
+spanning hosts, and the same GSPMD train steps scale unchanged — XLA routes
+collectives over ICI within a slice and DCN across slices.
+
+Wire-up on a pod (one learner role per host):
+
+    machines.json: one worker fleet as usual; each learner host runs
+        python -m tpu_rl learner --params ... --machines ... \
+            (with coordinator/num_processes/process_id in the params file)
+
+    params.json: {"multihost": {"coordinator": "10.0.0.1:8476",
+                  "num_processes": 4, "process_id": <host idx>}}
+
+Host-sharded feeding: each learner host assembles its own shard of the
+global batch from its local storage process (``jax.device_put`` with the
+host-local addressable shards of the global sharding); the framework's
+storage/assembler stack is per-host already, so the data plane needs no
+change — only batch placement (``host_local_batch_to_global``).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def init_multihost(
+    coordinator: str, num_processes: int, process_id: int, **kw
+) -> None:
+    """Bring this host into the JAX distributed runtime. Must run before any
+    other JAX call in the process. No-op when num_processes == 1."""
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kw,
+    )
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def host_local_batch_to_global(batch, sharding):
+    """Assemble a global device array from each host's LOCAL batch shard.
+
+    ``batch``: pytree of host numpy arrays holding THIS host's rows of the
+    global batch (each host's storage feeds its own chips — no cross-host
+    data movement). ``sharding``: the global NamedSharding the train step
+    expects. Returns a pytree of global jax.Arrays.
+    """
+
+    def place(x):
+        x = np.asarray(x)
+        global_shape = (x.shape[0] * jax.process_count(), *x.shape[1:])
+        # The sharding defines which global rows live on which device
+        # (addressable_devices is an unordered set — never zip against it).
+        idx_map = sharding.addressable_devices_indices_map(global_shape)
+        # This host owns a contiguous block of global rows.
+        row0 = min(
+            (idx[0].start or 0) for idx in idx_map.values()
+        )
+        arrays = []
+        for dev, idx in idx_map.items():
+            sl = idx[0]
+            start = (sl.start or 0) - row0
+            stop = (sl.stop or global_shape[0]) - row0
+            assert 0 <= start < stop <= x.shape[0], (
+                "host-local batch does not cover this host's shard rows "
+                f"({start}:{stop} of {x.shape[0]}); feed each host exactly "
+                "its rows of the global batch"
+            )
+            arrays.append(jax.device_put(x[start:stop], dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, arrays
+        )
+
+    return jax.tree_util.tree_map(place, batch)

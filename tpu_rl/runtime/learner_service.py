@@ -59,12 +59,18 @@ class LearnerService:
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
+        cfg = self.cfg
+        if cfg.multihost:
+            # Must precede any backend use in this process; afterwards
+            # jax.devices() spans every host in the slice.
+            from tpu_rl.parallel.multihost import init_multihost
+
+            init_multihost(**cfg.multihost)
+
         import jax
 
         from tpu_rl.algos.registry import get_algo
         from tpu_rl.checkpoint import Checkpointer
-
-        cfg = self.cfg
         layout = BatchLayout.from_config(cfg)
         store = make_store(cfg, layout, handles=self.handles)
         off_policy = is_off_policy(cfg.algo)
@@ -92,18 +98,26 @@ class LearnerService:
                 print(f"[learner] resumed from checkpoint idx {start_idx}")
 
         # ---- compile: single-chip jit, data-parallel, or data x seq mesh ----
+        self._place_global = None
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
             from tpu_rl.parallel.dp import make_sp_train_step, replicate
+            from tpu_rl.parallel.sequence import DATA_AXIS, SEQ_AXIS
 
             train_step = make_sp_train_step(train_step, mesh, cfg)
             state = replicate(state, mesh)
+            self._setup_multihost_feed(
+                NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+            )
         elif cfg.mesh_data > 1:
             from tpu_rl.parallel.dp import make_parallel_train_step, replicate
-            from tpu_rl.parallel.mesh import make_mesh
+            from tpu_rl.parallel.mesh import batch_sharding, make_mesh
 
             mesh = make_mesh(cfg.mesh_data)
             train_step = make_parallel_train_step(train_step, mesh, cfg)
             state = replicate(state, mesh)
+            self._setup_multihost_feed(batch_sharding(mesh))
         else:
             train_step = jax.jit(train_step, donate_argnums=(0,))
 
@@ -181,9 +195,24 @@ class LearnerService:
             return store.sample(self.cfg.batch_size, rng)
         return store.consume()
 
+    def _setup_multihost_feed(self, sharding) -> None:
+        """On a multi-host mesh, each learner host feeds its OWN rows of the
+        global batch (its storage process only sees local workers); batches
+        must be placed as global arrays via the sharding's device->row map."""
+        import jax
+
+        if jax.process_count() > 1:
+            self._place_global = sharding
+
     def _to_batch(self, raw: dict):
         from tpu_rl.types import Batch
 
+        if self._place_global is not None:
+            from tpu_rl.parallel.multihost import host_local_batch_to_global
+
+            return Batch(
+                **host_local_batch_to_global(raw, self._place_global)
+            )
         return Batch.from_mapping(raw)
 
     # ------------------------------------------------------------ broadcast
