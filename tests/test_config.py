@@ -1,0 +1,40 @@
+"""Config validation tests (SURVEY.md §5.6; misconfig must fail fast)."""
+
+import pytest
+
+from tpu_rl.config import Config
+
+
+def test_continuous_env_rejected_for_discrete_algos():
+    for algo in ("PPO", "IMPALA", "V-MPO", "SAC"):
+        with pytest.raises(ValueError, match="discrete-only"):
+            Config.from_dict({"algo": algo, "is_continuous": True})
+
+
+def test_continuous_algos_accept_continuous_env():
+    for algo in ("PPO-Continuous", "SAC-Continuous"):
+        Config.from_dict(
+            {"algo": algo, "is_continuous": True, "action_space": 1}
+        )
+
+
+def test_bf16_requires_transformer():
+    with pytest.raises(AssertionError, match="bfloat16"):
+        Config.from_dict({"compute_dtype": "bfloat16", "model": "lstm"})
+    Config.from_dict(
+        {"compute_dtype": "bfloat16", "model": "transformer", "algo": "PPO"}
+    )
+
+
+def test_sequence_parallel_constraints():
+    with pytest.raises(AssertionError):
+        Config.from_dict({"mesh_seq": 2, "model": "lstm"})
+    with pytest.raises(AssertionError):  # seq_len % mesh_seq
+        Config.from_dict(
+            {
+                "mesh_seq": 3,
+                "model": "transformer",
+                "attention_impl": "ring",
+                "seq_len": 8,
+            }
+        )

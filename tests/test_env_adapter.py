@@ -17,7 +17,7 @@ def test_probe_spaces_discrete():
 
 
 def test_probe_spaces_continuous():
-    cfg = probe_spaces(small_config(env="Pendulum-v1"))
+    cfg = probe_spaces(small_config(env="Pendulum-v1", algo="PPO-Continuous"))
     assert cfg.obs_shape == (3,)
     assert cfg.action_space == 1
     assert cfg.is_continuous
@@ -35,7 +35,7 @@ def test_discrete_roundtrip():
 
 
 def test_continuous_action_shaping():
-    cfg = probe_spaces(small_config(env="Pendulum-v1"))
+    cfg = probe_spaces(small_config(env="Pendulum-v1", algo="PPO-Continuous"))
     env = EnvAdapter(cfg, seed=0)
     env.reset()
     obs, rew, done = env.step(np.asarray([0.5], np.float32))

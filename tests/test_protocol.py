@@ -2,6 +2,7 @@
 
 import os
 import pickle
+import struct
 import zlib
 
 import numpy as np
@@ -115,6 +116,153 @@ class TestProtocol:
         np.testing.assert_array_equal(out, arr)
 
 
+# ------------------------------------------------------------- safe serializer
+class TestWireSerializer:
+    """The wire body is a closed-schema serialization, not pickle — a hostile
+    frame must not be able to execute code on decode (round-1 advisor
+    finding)."""
+
+    def test_roundtrip_every_supported_type(self):
+        from tpu_rl.runtime.protocol import pack, unpack
+
+        payload = {
+            "none": None,
+            "bools": [True, False],
+            "int": -(2**40),
+            "float": 3.14159,
+            "str": "épisode-αβ",
+            "bytes": b"\x00\xffraw",
+            "tuple": (1, 2.0, "three"),
+            "nested": {"params": {"w": np.random.randn(8, 8).astype(np.float32)}},
+            "arrays": [
+                np.arange(10, dtype=np.int32),
+                np.ones((2, 3, 4), np.float64),
+                np.array(True),
+                np.zeros((0, 5), np.float32),  # zero-size
+                np.float32(1.5),  # numpy scalar -> 0-d array
+            ],
+        }
+        out = unpack(pack(payload))
+        assert out["none"] is None
+        assert out["bools"] == [True, False]
+        assert out["int"] == -(2**40)
+        assert out["float"] == payload["float"]
+        assert out["str"] == payload["str"]
+        assert out["bytes"] == payload["bytes"]
+        assert out["tuple"] == payload["tuple"]
+        np.testing.assert_array_equal(
+            out["nested"]["params"]["w"], payload["nested"]["params"]["w"]
+        )
+        for got, want in zip(out["arrays"], payload["arrays"]):
+            want = np.asarray(want)
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)
+
+    def test_fortran_order_array_roundtrips(self):
+        from tpu_rl.runtime.protocol import pack, unpack
+
+        a = np.asfortranarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_array_equal(unpack(pack(a)), a)
+
+    def test_object_dtype_rejected_on_encode(self):
+        from tpu_rl.runtime.protocol import pack
+
+        with pytest.raises(ValueError, match="dtype|unsupported"):
+            pack(np.array([object()], dtype=object))
+        with pytest.raises(ValueError, match="unsupported|dtype"):
+            pack(object())
+        with pytest.raises(ValueError, match="non-str"):
+            pack({1: "int-keyed"})
+
+    def test_pickle_body_cannot_execute(self, tmp_path):
+        """A frame whose body is a malicious pickle must raise, not execute."""
+        import struct
+        import zlib as _z
+
+        from tpu_rl.runtime.protocol import Codec, _HEADER, _MAGIC, _VERSION
+
+        marker = tmp_path / "pwned"
+
+        class Evil:
+            def __reduce__(self):
+                return (open, (str(marker), "w"))
+
+        evil = pickle.dumps(Evil())
+        header = _HEADER.pack(
+            _MAGIC, _VERSION, Codec.RAW, len(evil), _z.crc32(evil) & 0xFFFFFFFF
+        )
+        with pytest.raises(ValueError):
+            decode([bytes([Protocol.Rollout]), header + evil])
+        assert not marker.exists()
+
+    def test_truncated_and_trailing_rejected(self):
+        from tpu_rl.runtime.protocol import pack, unpack
+
+        buf = pack({"a": np.arange(5)})
+        with pytest.raises(ValueError):
+            unpack(buf[:-3])
+        with pytest.raises(ValueError):
+            unpack(buf + b"xx")
+
+    def test_every_reject_path_raises_valueerror_only(self):
+        """Sub.recv drops frames on `except ValueError` — any other exception
+        type escaping decode() crashes the role process (hostile-input DoS).
+        Exercise each normalization: garbage dtype (np.dtype -> TypeError),
+        corrupt zlib body (zlib.error), oversize int on encode (struct.error)."""
+        import zlib as _z
+
+        from tpu_rl.runtime.protocol import (
+            Codec,
+            _HEADER,
+            _MAGIC,
+            _VERSION,
+            pack,
+            unpack,
+        )
+
+        # garbage dtype string
+        forged = b"a" + struct.pack("<I", 2) + b"zz"
+        with pytest.raises(ValueError, match="dtype"):
+            unpack(forged)
+
+        # corrupt zlib body with valid CRC
+        body = b"\xde\xad\xbe\xef" * 8
+        header = _HEADER.pack(
+            _MAGIC, _VERSION, Codec.ZLIB, 64, _z.crc32(body) & 0xFFFFFFFF
+        )
+        with pytest.raises(ValueError, match="zlib"):
+            decode([bytes([Protocol.Rollout]), header + body])
+
+        # zlib bomb: expands past declared raw_size -> size mismatch, bounded
+        bomb = _z.compress(b"\x00" * 10_000_000, level=9)
+        header = _HEADER.pack(
+            _MAGIC, _VERSION, Codec.ZLIB, 64, _z.crc32(bomb) & 0xFFFFFFFF
+        )
+        with pytest.raises(ValueError, match="size mismatch"):
+            decode([bytes([Protocol.Rollout]), header + bomb])
+
+        # int outside int64 on encode
+        with pytest.raises(ValueError, match="int64"):
+            pack({"seed": 2**63})
+
+    def test_oversize_shape_rejected(self):
+        """A forged array header claiming a huge shape must not allocate."""
+        from tpu_rl.runtime.protocol import unpack
+
+        dt = b"<f4"
+        forged = (
+            b"a"
+            + struct.pack("<I", len(dt))
+            + dt
+            + struct.pack("<I", 1)
+            + struct.pack("<q", 2**50)  # claimed 1-quadrillion-row array
+            + struct.pack("<I", 4)
+            + b"\x00\x00\x00\x00"
+        )
+        with pytest.raises(ValueError):
+            unpack(forged)
+
+
 # ---------------------------------------------------------------- transport
 class TestTransport:
     def test_pub_sub_localhost(self):
@@ -157,11 +305,21 @@ class TestTransport:
         pub = Pub("127.0.0.1", port, bind=False)
         try:
             assert list(sub.drain()) == []
-            time.sleep(0.3)
+            # PUB/SUB slow-joiner: ping until the subscription propagates
+            # (a fixed sleep is a deterministic flake on slow hosts).
+            for _ in range(100):
+                pub.send(Protocol.Stat, -1.0)
+                if sub.recv(timeout_ms=100) is not None:
+                    break
+            else:
+                pytest.fail("subscription never propagated")
+            list(sub.drain())  # flush stray handshake pings
             pub.send(Protocol.Stat, 7.0)
             pub.send(Protocol.Stat, 8.0)
-            time.sleep(0.3)
-            vals = [v for _, v in sub.drain()]
+            deadline = time.time() + 10.0
+            vals = []
+            while len(vals) < 2 and time.time() < deadline:
+                vals += [v for _, v in sub.drain() if v >= 0]
             assert vals == [7.0, 8.0]
         finally:
             pub.close()

@@ -10,15 +10,19 @@ process, and a mesh-data-parallel TPU learner compiled with ``jax.jit``.
 Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
 
 - ``tpu_rl.config``     — typed config, parameters/machines JSON loaders
-- ``tpu_rl.models``     — Flax policies: MLP torso -> lax.scan LSTM -> heads
-- ``tpu_rl.ops``        — pure-JAX GAE / V-trace / distributions / huber / polyak
+- ``tpu_rl.models``     — Flax policies: LSTM families, transformer, fused cell
+- ``tpu_rl.ops``        — pure-JAX GAE / V-trace / losses / distributions /
+  target nets + the Pallas fused-LSTM kernel
 - ``tpu_rl.algos``      — jitted train_step per algorithm + registry
-- ``tpu_rl.data``       — trajectory assembly, shared-memory batch store, replay
-- ``tpu_rl.transport``  — ZMQ PUB/SUB wire protocol + codec (DCN path)
-- ``tpu_rl.agents``     — worker / manager / storage / learner processes
-- ``tpu_rl.parallel``   — device mesh, data-parallel shardings (ICI path)
-- ``tpu_rl.envs``       — Gym adapter + fake envs for tests
-- ``tpu_rl.utils``      — timers, checkpointing, logging, process supervision
+- ``tpu_rl.data``       — trajectory assembly, shm batch stores, batch layout
+- ``tpu_rl.runtime``    — wire protocol/codec (DCN path), ZMQ transport,
+  worker / manager / storage / learner processes, supervisor/runner, env
+  adapter, native-codec loader
+- ``tpu_rl.parallel``   — device mesh, data-parallel jit, ring/Ulysses
+  sequence parallelism (ICI path), multihost init
+- ``tpu_rl.checkpoint`` — orbax params+opt+step save/resume
+- ``tpu_rl.launch``     — cluster launcher (rsync+ssh+tmux plan/execute)
+- ``tpu_rl.utils``      — timers, metrics, crash logs, platform forcing
 """
 
 __version__ = "0.1.0"

@@ -122,6 +122,11 @@ class Config:
     multihost: dict | None = None
     # Compute dtype for the train step ("float32" or "bfloat16").
     compute_dtype: str = "float32"
+    # Learner device: "auto" (own the accelerator — reference learner
+    # semantics, main.py:66-68) or "cpu" (force the CPU backend in the
+    # learner child too; used by CI and by deployments where another
+    # process owns the chip).
+    learner_device: str = "auto"
     # Worker step throttle, seconds (reference hard-codes 0.05:
     # /root/reference/agents/worker.py:131). 0 disables.
     worker_step_sleep: float = 0.05
@@ -170,6 +175,7 @@ class Config:
                 "model='transformer' (LSTM families run float32)"
             )
         assert self.attention_impl in ("full", "ring", "ulysses")
+        assert self.learner_device in ("auto", "cpu"), self.learner_device
         if self.mesh_seq > 1:
             assert self.model == "transformer", (
                 "sequence parallelism (mesh_seq>1) requires model='transformer'"
@@ -186,6 +192,18 @@ class Config:
         if self.model == "transformer":
             assert not is_off_policy(self.algo), (
                 "transformer backbone supports the on-policy algorithms"
+            )
+        # A continuous env paired with a discrete-only algo would otherwise
+        # build DiscreteActorCritic unconditionally (families.py) and fail
+        # obscurely downstream; fail fast here instead. (is_continuous is
+        # runtime-derived: this check fires on the post-probe replace().
+        # Discreteness follows the registry's "-Continuous" naming
+        # convention so future algos are covered without editing this list.)
+        if self.is_continuous and not self.algo.endswith("-Continuous"):
+            raise ValueError(
+                f"algo {self.algo!r} is discrete-only but env {self.env!r} "
+                "has a continuous action space; use PPO-Continuous or "
+                "SAC-Continuous"
             )
 
     @property

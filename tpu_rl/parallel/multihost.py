@@ -77,7 +77,10 @@ def host_local_batch_to_global(batch, sharding):
                 f"({start}:{stop} of {x.shape[0]}); feed each host exactly "
                 "its rows of the global batch"
             )
-            arrays.append(jax.device_put(x[start:stop], dev))
+            # Keep the device's non-batch index dims (e.g. the seq slice
+            # under a (data, seq) sequence-parallel sharding): only the row
+            # slice is host-offset; trailing dims are global-sized locally.
+            arrays.append(jax.device_put(x[(slice(start, stop), *idx[1:])], dev))
         return jax.make_array_from_single_device_arrays(
             global_shape, sharding, arrays
         )

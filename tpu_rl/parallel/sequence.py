@@ -99,10 +99,25 @@ def ring_attention(
       q_pos   : (B, Tl) global positions of this shard's rows
       seg     : (B, Tl) global segment ids (episode index) of this shard
     Returns (B, Tl, H, D).
+
+    Differentiable via a custom VJP that re-runs the ring on the backward
+    pass (the ring attention paper's scheme): K/V blocks are *recomputed by
+    re-rotating*, never stored per step. Without this, autodiff would save
+    the scan carry — which includes the rotating ``(B, Tl, H, D)`` K/V
+    blocks — once per ring step, making backward residuals O(n · Tl) = the
+    full sequence per chip, defeating the O(T/n) memory claim exactly when
+    it matters (training). Residuals here are O(Tl): q, k, v, o, and the
+    per-row logsumexp.
     """
+    return _ring_attention_vjp(axis_name, bool(causal), q, k, v, q_pos, seg)
+
+
+def _ring_forward(axis_name, causal, q, k, v, q_pos, seg):
+    """One rotation of the ring: flash-style online softmax over the n K/V
+    blocks. Returns the normalized output and the per-row logsumexp (the
+    only softmax stat the backward pass needs)."""
     n = jax.lax.psum(1, axis_name)
     scale = 1.0 / np.sqrt(q.shape[-1])
-    B, Tl, H, D = q.shape
     # Derive the accumulators from q so they carry q's device-varying type
     # (shard_map's varying-axis tracking requires scan carries to keep a
     # stable type across iterations), then hold them in float32: softmax
@@ -134,7 +149,96 @@ def ring_attention(
     # self-attention — a row always sees itself) would have l == 0; guard
     # anyway so non-causal edge cases stay finite.
     l = jnp.maximum(l, 1e-30)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)  # (B, H, Tq)
+    out = (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ring_attention_vjp(axis_name, causal, q, k, v, q_pos, seg):
+    out, _ = _ring_forward(axis_name, causal, q, k, v, q_pos, seg)
+    return out
+
+
+def _ring_vjp_fwd(axis_name, causal, q, k, v, q_pos, seg):
+    out, lse = _ring_forward(axis_name, causal, q, k, v, q_pos, seg)
+    return out, (q, k, v, q_pos, seg, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, res, do):
+    """Second ring pass (flash-attention backward over rotating blocks).
+
+    Fixed per device: q, do, o, lse, delta. Rotating: the K/V block, its
+    positions/segments, and its dK/dV accumulators — after n hops each
+    dK/dV block has collected the contribution of every q shard and is
+    back on the device that owns that K/V shard. dQ accumulates locally.
+    """
+    q, k, v, q_pos, seg, out, lse = res
+    n = jax.lax.psum(1, axis_name)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    do32 = do.astype(jnp.float32)
+    out32 = out.astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    # delta_i = rowsum(dO * O): (B, Tq, H) -> (B, H, Tq)
+    delta = (do32 * out32).sum(axis=-1).transpose(0, 2, 1)
+    dq = jnp.zeros_like(q32)
+    dk = jnp.zeros_like(k, dtype=jnp.float32)
+    dv = jnp.zeros_like(v, dtype=jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, _):
+        dq, k_blk, v_blk, k_pos, k_seg, dk_blk, dv_blk = carry
+        scores = _masked_block_scores(
+            q, k_blk, q_pos, k_pos, seg, k_seg, scale, causal
+        )
+        # p = softmax prob against the GLOBAL normalizer; explicit zero on
+        # masked entries (a fully-masked row has lse ~ _NEG_INF, where
+        # exp(scores - lse) would bogusly be 1).
+        p = jnp.where(
+            scores <= _NEG_INF * 0.5,
+            0.0,
+            jnp.exp(scores - lse[..., None]),
+        )
+        dv_blk = dv_blk + jnp.einsum(
+            "bhqk,bqhd->bkhd", p, do32, preferred_element_type=jnp.float32
+        )
+        dp = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            do32,
+            v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[..., None]) * jnp.float32(scale)
+        dq = dq + jnp.einsum(
+            "bhqk,bkhd->bqhd",
+            ds,
+            k_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        dk_blk = dk_blk + jnp.einsum(
+            "bhqk,bqhd->bkhd", ds, q32, preferred_element_type=jnp.float32
+        )
+        k_blk, v_blk, k_pos, k_seg, dk_blk, dv_blk = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm),
+            (k_blk, v_blk, k_pos, k_seg, dk_blk, dv_blk),
+        )
+        return (dq, k_blk, v_blk, k_pos, k_seg, dk_blk, dv_blk), None
+
+    (dq, _, _, _, _, dk, dv), _ = jax.lax.scan(
+        body, (dq, k, v, q_pos, seg, dk, dv), None, length=n
+    )
+    zero_pos = np.zeros(q_pos.shape, dtype=jax.dtypes.float0)
+    zero_seg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        zero_pos,
+        zero_seg,
+    )
+
+
+_ring_attention_vjp.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ulysses_attention(

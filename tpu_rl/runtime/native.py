@@ -12,6 +12,7 @@ zlib (the reference's equivalent native dep is c-blosc2,
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import tempfile
@@ -19,20 +20,37 @@ import threading
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 SRC = os.path.join(_REPO, "native", "codec.cpp")
-SO = os.path.join(_REPO, "native", "build", "libtpurl_codec.so")
+_BUILD_DIR = os.path.join(_REPO, "native", "build")
 
 _lock = threading.Lock()
 
 
 def _build() -> str | None:
+    """Build from source, caching by source hash: the artifact name embeds
+    the sha256 of codec.cpp, so a binary built from an OLDER source can never
+    shadow the current .cpp (the previous mtime comparison trusted whatever
+    a checkout happened to produce, e.g. a committed prebuilt .so). This is
+    a staleness guard, not tamper-proofing — build/ must stay writable only
+    by the deploy user, and is untracked/.gitignored."""
     if not os.path.exists(SRC):
         return None
-    if os.path.exists(SO) and os.path.getmtime(SO) >= os.path.getmtime(SRC):
-        return SO
-    os.makedirs(os.path.dirname(SO), exist_ok=True)
+    with open(SRC, "rb") as f:
+        src_hash = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_BUILD_DIR, f"libtpurl_codec_{src_hash}.so")
+    if os.path.exists(so):
+        return so
+    # Prune artifacts of older sources (each codec.cpp edit would otherwise
+    # leave an orphaned .so behind forever).
+    try:
+        for name in os.listdir(_BUILD_DIR):
+            if name.startswith("libtpurl_codec_") and name.endswith(".so"):
+                os.unlink(os.path.join(_BUILD_DIR, name))
+    except OSError:
+        pass
+    os.makedirs(_BUILD_DIR, exist_ok=True)
     # Atomic build: compile to a temp name, rename into place (concurrent
     # role processes may race to build at first launch).
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(SO))
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
     os.close(fd)
     try:
         subprocess.run(
@@ -41,8 +59,8 @@ def _build() -> str | None:
             capture_output=True,
             timeout=120,
         )
-        os.replace(tmp, SO)
-        return SO
+        os.replace(tmp, so)
+        return so
     except (subprocess.SubprocessError, OSError):
         try:
             os.unlink(tmp)

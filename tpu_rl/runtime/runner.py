@@ -15,9 +15,10 @@ Roles (reference CLI ``main.py:475-508``):
 - ``worker``   : ``num_p`` actor processes (reference ``worker_sub_process``)
 - ``local``    : everything on one host — the smallest real cluster
 
-Workers/managers/storage are CPU processes; the runner pins
-``JAX_PLATFORMS=cpu`` into their environment so only the learner touches the
-TPU.
+Workers/managers/storage are CPU processes: ``role_entry`` forces the CPU
+backend in-process (``utils.platform.force_cpu``) so only the learner touches
+the TPU. The ``JAX_PLATFORMS=cpu`` env pin is kept as belt-and-braces, but it
+is NOT sufficient on its own — the TPU plugin here ignores the env var.
 """
 
 from __future__ import annotations
@@ -95,7 +96,9 @@ class Supervisor:
         hb = self.ctx.Value("d", time.time())
         child = Child(
             name=name,
-            target=functools.partial(role_entry, target, name, self.log_root),
+            target=functools.partial(
+                role_entry, target, name, self.log_root, cpu_only=cpu_only
+            ),
             args=(*args, self.stop_event, hb),
             proc=None,  # type: ignore[arg-type]
             heartbeat=hb,
@@ -235,7 +238,9 @@ def learner_role(
         handles,
         machines.model_port,
         stat_array,
-        cpu_only=False,
+        # "auto": the learner owns the accelerator. "cpu": force the CPU
+        # backend (CI, or when another process holds the chip).
+        cpu_only=(cfg.learner_device == "cpu"),
     )
     return sup
 

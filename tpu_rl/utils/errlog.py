@@ -24,9 +24,21 @@ def save_error_log(role: str, exc: BaseException, log_root: str = "logs") -> str
     return path
 
 
-def role_entry(target, role: str, log_root: str, *args) -> None:
+def role_entry(
+    target, role: str, log_root: str, *args, cpu_only: bool = False
+) -> None:
     """mp.Process target wrapper: run ``target(*args)``; on exception, write
-    the crash log and re-raise (the supervisor sees a nonzero exit)."""
+    the crash log and re-raise (the supervisor sees a nonzero exit).
+
+    ``cpu_only`` children force the CPU backend *in-process* before the role
+    runs any jax op — the ``JAX_PLATFORMS`` env pin is ignored by the TPU
+    plugin in this environment, and a worker that opens libtpu deadlocks the
+    learner on the libtpu lockfile (see ``utils.platform``).
+    """
+    if cpu_only:
+        from tpu_rl.utils.platform import force_cpu
+
+        force_cpu()
     try:
         target(*args)
     except BaseException as exc:  # noqa: BLE001 — log everything, incl. SystemExit

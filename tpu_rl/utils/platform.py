@@ -1,0 +1,46 @@
+"""In-process JAX platform forcing.
+
+In this environment the TPU plugin **ignores the ``JAX_PLATFORMS`` env var**:
+``env JAX_PLATFORMS=cpu python -c "import jax; print(jax.devices())"`` still
+returns the TPU. The only override that works is
+``jax.config.update("jax_platforms", "cpu")`` — and it must win even when a
+backend (possibly the TPU client) was already initialized by the calling
+process, which requires dropping the live backends first.
+
+Two call sites depend on this:
+- ``__graft_entry__.dryrun_multichip`` — the driver invokes it in a process
+  whose platform state is unknown (it may have compile-checked ``entry()``
+  on the real chip first).
+- supervisor children flagged ``cpu_only`` (workers/managers/storage) — the
+  env pin alone let them open libtpu and die on lockfile contention with the
+  learner (reference topology: only the learner owns the accelerator,
+  ``/root/reference/main.py:66-68``).
+"""
+
+from __future__ import annotations
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force this process onto the CPU backend, optionally with ``n_devices``
+    virtual devices (for mesh tests / multichip dryruns).
+
+    Safe to call before or after jax backend initialization; idempotent.
+    """
+    import jax
+
+    try:
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()  # no-op if nothing was initialized yet
+    except Exception:
+        pass  # very old/new jax: fall through, config update may still work
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        # Takes precedence over any --xla_force_host_platform_device_count
+        # in XLA_FLAGS (verified on jax 0.9.0).
+        jax.config.update("jax_num_cpu_devices", int(n_devices))
+        got = len(jax.devices())
+        if got < int(n_devices):
+            raise RuntimeError(
+                f"requested {n_devices} CPU devices but backend created {got}"
+            )
