@@ -173,7 +173,12 @@ class ReplayStore(_StoreBase):
         (overwritten mid-read) are re-drawn via the seqlock; if a consistent
         sample cannot be assembled within the retry budget, returns None
         (callers treat it as "not ready") — a torn trajectory is NEVER
-        returned, unlike the reference sampler (``agents/learner.py:168-195``)."""
+        returned, unlike the reference sampler (``agents/learner.py:168-195``).
+
+        Vectorized: each retry round is one fancy-index copy per field over
+        the still-pending rows plus two vector version reads (the round-1
+        implementation looped slot-by-slot in Python — O(batch) interpreter
+        iterations per learner update)."""
         n = self.size
         if n < batch:
             return None
@@ -184,18 +189,21 @@ class ReplayStore(_StoreBase):
             )
             for f in BATCH_FIELDS
         }
-        for i, slot in enumerate(idx):
-            for _ in range(max_retries):
-                v1 = int(self.versions[slot])
-                if v1 % 2 == 0:
-                    for f in BATCH_FIELDS:
-                        out[f][i] = self.views[f][slot]
-                    if int(self.versions[slot]) == v1:
-                        break
-                slot = int(rng.integers(0, n))  # torn: re-draw
-            else:
-                return None  # retry budget exhausted; sample again later
-        return out
+        pending = np.arange(batch)
+        for _ in range(max_retries):
+            sel = idx[pending]
+            v1 = self.versions[sel].copy()
+            chunk = {f: self.views[f][sel] for f in BATCH_FIELDS}  # copies
+            v2 = self.versions[sel].copy()
+            ok = (v1 % 2 == 0) & (v2 == v1)
+            done = pending[ok]
+            for f in BATCH_FIELDS:
+                out[f][done] = chunk[f][ok]
+            pending = pending[~ok]
+            if pending.size == 0:
+                return out
+            idx[pending] = rng.integers(0, n, size=pending.size)  # re-draw
+        return None  # retry budget exhausted; sample again later
 
 
 def make_store(cfg, layout: BatchLayout, handles: ShmHandles | None = None):
