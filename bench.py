@@ -1,25 +1,34 @@
-"""Learner-FPS benchmark.
+"""Learner benchmark suite.
 
 Measures steady-state learner throughput in transitions/sec — the reference's
 own headline metric (`learner-throughput` timer, ``/root/reference/agents/
 learner.py:34-36`` + ``utils/utils.py:167-189``: transitions/update =
-seq_len x batch_size = 640, window 100) — for the jitted IMPALA (V-trace) train
-step at the reference's exact batch quantum (batch 128, seq 5, hidden 64,
-CartPole shapes), on whatever accelerator JAX exposes.
+seq_len x batch_size = 640, window 100) — plus achieved FLOPs and MFU, for:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- all six algorithms at the reference's exact batch quantum (batch 128 x
+  seq 5 x hidden 64) — the apples-to-apples rows. These are LATENCY-bound:
+  640 transitions of a 64-wide LSTM is <<1% of a TPU's MXU, so transitions/sec
+  measures dispatch+fusion quality, not chip capability;
+- a wide-LSTM IMPALA workload and a long-context bf16 transformer PPO
+  workload sized to load the MXU — the chip-utilization rows.
 
-Baseline for vs_baseline: the reference's maximum sustainable learner ingest,
-bounded by its configured actor fleet = 3 machines x 10 workers x ~20 env
-steps/s (hard 0.05 s sleep, ``agents/worker.py:131``; fleet config
-``utils/machines.json:6-25``) = 600 transitions/sec. The reference publishes
-no measured numbers (BASELINE.md), so its by-construction ceiling is the only
-defensible denominator.
+FLOPs are XLA's own analytical count for the compiled step
+(``compiled.cost_analysis()["flops"]``); MFU is achieved FLOPs/s over the
+chip's bf16 peak. The reference publishes no measured numbers (BASELINE.md);
+its by-construction ceiling is 600 transitions/s (3 machines x 10 workers x
+~20 env-steps/s: hard 0.05 s sleep ``agents/worker.py:131``, fleet config
+``utils/machines.json:6-25``), which is the only defensible denominator for
+``vs_baseline``.
+
+stdout: ONE JSON line {"metric", "value", "unit", "vs_baseline"} (the IMPALA
+reference-quantum row — same headline as rounds 1-2).
+Full matrix: written to ``bench_results.json`` and printed to stderr.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -28,47 +37,57 @@ import numpy as np
 
 REFERENCE_BASELINE_TPS = 600.0  # see module docstring
 
+# bf16 peak FLOPs/s per chip by device kind (public spec sheets). MFU is
+# reported against bf16 peak regardless of compute dtype (standard MFU
+# convention); None (e.g. CPU test runs) -> mfu omitted.
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6": 918e12,  # Trillium
+}
 
-def make_bench(algo: str = "IMPALA"):
-    from tpu_rl.algos.registry import get_algo
-    from tpu_rl.config import Config
-    from tpu_rl.parallel import make_mesh, make_parallel_train_step, replicate, shard_batch
+
+def device_peak_flops() -> float | None:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k) or k in kind:
+            return v
+    return None
+
+
+def _make_batch(cfg, family):
+    """Random batch at cfg shapes with the wire layout's carry widths."""
+    from tpu_rl.data.layout import BatchLayout
     from tpu_rl.types import Batch
 
-    cfg = Config.from_dict(
-        dict(
-            algo=algo,
-            hidden_size=64,
-            seq_len=5,
-            batch_size=128,
-            obs_shape=(4,),
-            action_space=2,
-        )
-    )
-    family, state, train_step = get_algo(algo).build(cfg, jax.random.key(0))
-    n_dev = len(jax.devices())
-    # Use every visible chip; keep the global batch at the reference quantum.
-    mesh = make_mesh(n_dev if cfg.batch_size % n_dev == 0 else 1)
-    pstep = make_parallel_train_step(train_step, mesh, cfg)
-
+    lay = BatchLayout.from_config(cfg)
     rng = np.random.default_rng(0)
     zb = Batch.zeros(
         cfg.batch_size, cfg.seq_len, cfg.obs_shape, cfg.action_space,
         cfg.hidden_size, continuous=family.continuous,
+        hx_width=lay.hx, cx_width=lay.cx,
     )
-    batch = zb.replace(
+    firsts = np.zeros(zb.is_fir.shape, np.float32)
+    firsts[:, 0] = 1.0
+    if family.continuous:
+        act = rng.normal(size=zb.act.shape).astype(np.float32) * 0.3
+        log_prob = np.full(zb.log_prob.shape, -1.0, np.float32)
+    else:
+        act = rng.integers(0, cfg.action_space, size=zb.act.shape).astype(
+            np.float32
+        )
+        log_prob = np.full(
+            zb.log_prob.shape, -float(np.log(cfg.action_space)), np.float32
+        )
+    return zb.replace(
         obs=jnp.asarray(rng.normal(size=zb.obs.shape).astype(np.float32)),
-        act=jnp.asarray(
-            rng.integers(0, cfg.action_space, size=zb.act.shape).astype(np.float32)
-        ),
+        act=jnp.asarray(act),
         rew=jnp.asarray(rng.normal(size=zb.rew.shape).astype(np.float32) * 0.1),
-        log_prob=jnp.full(zb.log_prob.shape, -float(np.log(cfg.action_space))),
+        log_prob=jnp.asarray(log_prob),
+        is_fir=jnp.asarray(firsts),
     )
-    state = replicate(state, mesh)
-    batch = shard_batch(batch, mesh)
-    key = replicate(jax.random.key(1), mesh)
-    transitions_per_update = cfg.batch_size * cfg.seq_len
-    return pstep, state, batch, key, transitions_per_update
 
 
 def _sync(metrics) -> float:
@@ -79,8 +98,28 @@ def _sync(metrics) -> float:
     return float(np.asarray(jax.device_get(metrics["loss"])))
 
 
-def run(warmup: int = 10, iters: int = 200) -> dict:
-    pstep, state, batch, key, tpu_quantum = make_bench()
+def bench_one(name: str, cfg_kw: dict, warmup: int, iters: int) -> dict:
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.config import Config
+    from tpu_rl.parallel import make_mesh, make_parallel_train_step, replicate, shard_batch
+
+    cfg = Config.from_dict(cfg_kw)
+    family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(0))
+    n_vis = len(jax.devices())
+    # Use every visible chip; keep the global batch at the workload quantum.
+    n_dev = n_vis if cfg.batch_size % n_vis == 0 else 1
+    mesh = make_mesh(n_dev)
+    pstep = make_parallel_train_step(train_step, mesh, cfg)
+
+    batch = shard_batch(_make_batch(cfg, family), mesh)
+    state = replicate(state, mesh)
+    key = replicate(jax.random.key(1), mesh)
+
+    lowered = pstep.lower(state, batch, key)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    flops_per_step = float(cost.get("flops", 0.0))
+
     metrics = None
     for _ in range(warmup):
         state, metrics = pstep(state, batch, key)
@@ -95,14 +134,115 @@ def run(warmup: int = 10, iters: int = 200) -> dict:
     _sync(metrics)
     dt = time.perf_counter() - t0
 
-    tps = iters * tpu_quantum / dt
+    transitions = cfg.batch_size * cfg.seq_len
+    tps = iters * transitions / dt
+    achieved = flops_per_step * iters / dt
+    peak = device_peak_flops()
+    mfu = (achieved / (peak * n_dev)) if (peak and achieved) else None
+    return {
+        "name": name,
+        "algo": cfg.algo,
+        "model": cfg.model,
+        "compute_dtype": cfg.compute_dtype,
+        "batch": cfg.batch_size,
+        "seq": cfg.seq_len,
+        "hidden": cfg.hidden_size,
+        "step_ms": round(dt / iters * 1e3, 3),
+        "tps": round(tps, 1),
+        "flops_per_step": flops_per_step,
+        "achieved_flops_per_s": round(achieved, 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "regime": (
+            "latency-bound" if (mfu is None or mfu < 0.01) else "compute-bound"
+        ),
+        "devices": n_dev,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+# The benchmark matrix. Reference-quantum rows use the reference's exact
+# shapes (``/root/reference/utils/parameters.json:13-14,27``: batch 128 x
+# seq 5, hidden 64; CartPole (4,)/2 discrete, MountainCarContinuous (2,)/1
+# continuous). Saturating rows are sized to load the MXU on one chip.
+_REF = dict(batch_size=128, seq_len=5, hidden_size=64)
+_DISC = dict(obs_shape=(4,), action_space=2)
+_CONT = dict(obs_shape=(2,), action_space=1, is_continuous=True)
+
+WORKLOADS: list[tuple[str, dict, int, int]] = [
+    ("IMPALA@ref", dict(algo="IMPALA", **_REF, **_DISC), 10, 200),
+    ("PPO@ref", dict(algo="PPO", **_REF, **_DISC), 10, 200),
+    ("V-MPO@ref", dict(algo="V-MPO", **_REF, **_DISC), 10, 200),
+    ("SAC@ref", dict(algo="SAC", **_REF, **_DISC), 10, 100),
+    ("PPO-Continuous@ref", dict(algo="PPO-Continuous", **_REF, **_CONT), 10, 200),
+    ("SAC-Continuous@ref", dict(algo="SAC-Continuous", **_REF, **_CONT), 10, 100),
+    (
+        "IMPALA@wide-lstm",
+        dict(
+            algo="IMPALA", batch_size=1024, seq_len=16, hidden_size=1024,
+            obs_shape=(64,), action_space=8,
+        ),
+        5, 30,
+    ),
+    (
+        "PPO-transformer@longctx",
+        dict(
+            algo="PPO", model="transformer", compute_dtype="bfloat16",
+            batch_size=8, seq_len=2048, hidden_size=512, n_heads=8,
+            n_layers=4, obs_shape=(64,), action_space=8,
+        ),
+        3, 20,
+    ),
+]
+
+
+def run_all(out_path: str = "bench_results.json") -> dict:
+    rows = []
+    for name, cfg_kw, warmup, iters in WORKLOADS:
+        try:
+            row = bench_one(name, cfg_kw, warmup, iters)
+        except Exception as e:  # record, don't abort the whole matrix
+            row = {"name": name, "error": f"{type(e).__name__}: {e}"}
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+
+    result = {
+        "device_kind": jax.devices()[0].device_kind,
+        "n_devices": len(jax.devices()),
+        "peak_bf16_flops_per_chip": device_peak_flops(),
+        "reference_baseline_tps": REFERENCE_BASELINE_TPS,
+        "rows": rows,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+
+    headline = next(
+        (r for r in rows if r.get("name") == "IMPALA@ref" and "tps" in r), None
+    )
+    if headline is None:
+        return {
+            "metric": "learner FPS (IMPALA V-trace, batch 128 x seq 5)",
+            "value": 0.0,
+            "unit": "transitions/sec",
+            "vs_baseline": 0.0,
+        }
     return {
         "metric": "learner FPS (IMPALA V-trace, batch 128 x seq 5)",
-        "value": round(tps, 1),
+        "value": headline["tps"],
         "unit": "transitions/sec",
-        "vs_baseline": round(tps / REFERENCE_BASELINE_TPS, 2),
+        "vs_baseline": round(headline["tps"] / REFERENCE_BASELINE_TPS, 2),
+    }
+
+
+def run(warmup: int = 10, iters: int = 200) -> dict:
+    """Back-compat single-workload entry (headline row only)."""
+    row = bench_one("IMPALA@ref", dict(algo="IMPALA", **_REF, **_DISC), warmup, iters)
+    return {
+        "metric": "learner FPS (IMPALA V-trace, batch 128 x seq 5)",
+        "value": row["tps"],
+        "unit": "transitions/sec",
+        "vs_baseline": round(row["tps"] / REFERENCE_BASELINE_TPS, 2),
     }
 
 
 if __name__ == "__main__":
-    print(json.dumps(run()))
+    print(json.dumps(run_all()))

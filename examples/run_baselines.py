@@ -1,0 +1,106 @@
+"""Run the five BASELINE.json benchmark configs end-to-end and record
+learning results (final/curve returns + wallclock + env-steps), writing
+``BASELINE_RESULTS.json`` rows.
+
+Configs (BASELINE.md "Benchmark configs to reproduce"):
+  1. PPO discrete        — CartPole-v1, target return 500
+  2. IMPALA discrete     — CartPole-v1 (V-trace), target return 500
+  3. PPO-Continuous      — MountainCarContinuous-v0, solved = 50-game mean >= 90
+  4. SAC-Continuous      — MountainCarContinuous-v0 (off-policy replay path)
+  5. V-MPO discrete      — CartPole-v1
+
+Targets: CartPole-v1 return 500 is the reference's implicit success criterion
+(= its ``time_horizon`` cap, ``/root/reference/utils/parameters.json:2,11``);
+MountainCarContinuous "solved" is gymnasium's documented reward threshold 90
+(the reference README's claim is "solved", ``/root/reference/README.md:20-21``).
+
+Run (single config):
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/run_baselines.py \
+      --only IMPALA --updates 6000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from examples.train_inline import run  # noqa: E402
+
+CONFIGS: dict[str, dict] = {
+    "PPO": dict(
+        algo="PPO", env_name="CartPole-v1", target=500.0,
+        overrides=dict(entropy_coef=0.001),
+    ),
+    "IMPALA": dict(
+        algo="IMPALA", env_name="CartPole-v1", target=500.0,
+        overrides=dict(
+            entropy_coef=0.001,
+            entropy_anneal={"coef": 5e-5, "frac": 0.4},
+        ),
+    ),
+    "V-MPO": dict(
+        algo="V-MPO", env_name="CartPole-v1", target=500.0,
+        overrides=dict(entropy_coef=0.001),
+    ),
+    "PPO-Continuous": dict(
+        algo="PPO-Continuous", env_name="MountainCarContinuous-v0",
+        target=90.0,
+        overrides=dict(entropy_coef=0.01, time_horizon=999, reward_scale=0.1),
+    ),
+    "SAC-Continuous": dict(
+        algo="SAC-Continuous", env_name="MountainCarContinuous-v0",
+        target=90.0,
+        overrides=dict(
+            time_horizon=999, reward_scale=0.1, lr=3e-4, buffer_size=4096,
+        ),
+    ),
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--only", default=None, help="run a single config by name")
+    p.add_argument("--updates", type=int, default=6000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="BASELINE_RESULTS.json")
+    args = p.parse_args()
+
+    names = [args.only] if args.only else list(CONFIGS)
+    rows = []
+    for name in names:
+        spec = CONFIGS[name]
+        print(f"=== {name}: {spec['algo']} on {spec['env_name']} "
+              f"(target {spec['target']}) ===", flush=True)
+        stats = run(
+            updates=args.updates,
+            algo=spec["algo"],
+            env_name=spec["env_name"],
+            seed=args.seed,
+            target=spec["target"],
+            overrides=spec.get("overrides"),
+        )
+        rows.append(stats)
+        print(json.dumps(stats), flush=True)
+
+    # merge with any existing rows (one file accumulates the matrix)
+    existing: list = []
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                existing = json.load(f)
+        except Exception:
+            existing = []
+    by_key = {(r["algo"], r.get("seed", 0)): r for r in existing}
+    for r in rows:
+        by_key[(r["algo"], r.get("seed", 0))] = r
+    with open(args.out, "w") as f:
+        json.dump(list(by_key.values()), f, indent=1)
+    print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,9 +3,14 @@ public API: EnvAdapter env loop + seq-window assembly + jitted train step, no
 ZMQ. Works for discrete (CartPole) and continuous (Pendulum/MountainCarContinuous)
 envs — the reference's two showcase settings (``/root/reference/README.md``).
 
+On-policy algos consume each assembled batch once; off-policy algos (SAC*)
+accumulate sequence windows in a uniform replay buffer and sample from it —
+the inline equivalent of the reference's shared-memory replay path
+(``/root/reference/agents/learner.py:369-400``).
+
 Run:
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/train_inline.py \
-      [--algo PPO] [--env CartPole-v1] [--updates 250]
+      [--algo PPO] [--env CartPole-v1] [--updates 250] [--target 500]
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_rl.algos.registry import get_algo
-from tpu_rl.config import Config
+from tpu_rl.config import Config, is_off_policy
 from tpu_rl.runtime.env import EnvAdapter, probe_spaces
 from tpu_rl.types import BATCH_FIELDS, Batch
 
@@ -32,30 +37,48 @@ def act_params(state):
     return {"actor": state.params["actor"]}
 
 
-def main(
+def run(
     updates: int = 250,
     algo: str = "PPO",
     env_name: str = "CartPole-v1",
     seed: int = 0,
     batch_size: int = 32,
     log_every: int = 25,
-) -> float:
-    cfg = probe_spaces(
-        Config.from_dict(
-            dict(
-                algo=algo,
-                env=env_name,
-                batch_size=batch_size,
-                seq_len=5,
-                lr=3e-4,
-                entropy_coef=0.001,
-                reward_scale=0.1,
-                time_horizon=500,
-            )
-        )
+    target: float | None = None,
+    overrides: dict | None = None,
+) -> dict:
+    """Train and return a stats dict.
+
+    ``target``: stop early once the 50-game mean episode reward reaches it
+    (the reference's success criterion is expressed this way — CartPole-v1
+    return 500 = the ``time_horizon`` cap, ``/root/reference/utils/
+    parameters.json:2,11``; its tensorboard scalar is the 50-game mean,
+    ``agents/manager.py:62-79``).
+    """
+    cfg_dict = dict(
+        algo=algo,
+        env=env_name,
+        batch_size=batch_size,
+        seq_len=5,
+        lr=3e-4,
+        entropy_coef=0.001,
+        reward_scale=0.1,
+        time_horizon=500,
     )
-    family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(seed))
+    overrides = dict(overrides or {})
+    # Two-phase entropy schedule: {"coef": final, "frac": 0.5} switches the
+    # entropy bonus to ``coef`` after ``frac`` of the update budget — high
+    # early exploration, then a near-deterministic tail so capped-return
+    # targets (CartPole 500 = every step of every episode) are reachable.
+    # One extra jit compile at the boundary; everything else is unchanged.
+    anneal = overrides.pop("entropy_anneal", None)
+    cfg_dict.update(overrides)
+    cfg = probe_spaces(Config.from_dict(cfg_dict))
+    off_policy = is_off_policy(cfg.algo)
+    spec = get_algo(cfg.algo)
+    family, state, train_step = spec.build(cfg, jax.random.key(seed))
     train_step = jax.jit(train_step)
+    switch_at = int(anneal["frac"] * updates) if anneal else None
     act = jax.jit(family.act)
 
     env = EnvAdapter(cfg, seed=seed)
@@ -67,19 +90,34 @@ def main(
     is_fir = 1.0
     epi_rew, epi_steps = 0.0, 0
     rewards = collections.deque(maxlen=50)
+    rng = np.random.default_rng(seed)
 
     seq: list[dict] = []
     ready: list[dict] = []
+    # Off-policy replay of sequence windows (capacity in windows, matching the
+    # reference's trajectory-count capacity, ``utils/parameters.json:26``).
+    replay: collections.deque = collections.deque(maxlen=cfg.buffer_size)
+    env_steps = 0
+    update = 0
+    time_to_target = None
+    hit = False
     t0 = time.time()
 
-    for update in range(updates):
-        while len(ready) < cfg.batch_size:
+    def mean50() -> float:
+        return float(np.mean(rewards)) if rewards else float("nan")
+
+    while update < updates and not hit:
+        # ---- collect: one fresh window per update (off-policy) or a full
+        # batch of windows (on-policy).
+        need = 1 if (off_policy and len(replay) >= cfg.batch_size) else cfg.batch_size
+        while len(ready) < need:
             key, sub = jax.random.split(key)
             ob = jnp.asarray(obs, jnp.float32)[None]
             a, logits, log_prob, h2, c2 = act(act_params(state), ob, h, c, sub)
             next_obs, rew, done = env.step(np.asarray(a[0]))
             epi_rew += rew
             epi_steps += 1
+            env_steps += 1
             seq.append(
                 dict(
                     obs=np.asarray(ob[0]),
@@ -101,25 +139,76 @@ def main(
             obs, h, c = next_obs, h2, c2
             if done or epi_steps >= cfg.time_horizon:
                 rewards.append(epi_rew)
+                if (
+                    target is not None
+                    and len(rewards) == rewards.maxlen
+                    and mean50() >= target
+                ):
+                    time_to_target = time.time() - t0
+                    hit = True
                 obs = env.reset()
                 h = jnp.zeros_like(h)
                 c = jnp.zeros_like(c)
                 is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
 
+        # ---- train
+        if off_policy:
+            replay.extend(ready)
+            ready = []
+            if len(replay) < cfg.batch_size:
+                continue
+            idx = rng.integers(0, len(replay), size=cfg.batch_size)
+            picked = [replay[int(i)] for i in idx]
+        else:
+            picked, ready = ready, []
         batch = Batch.from_mapping(
-            {k: np.stack([t[k] for t in ready]) for k in BATCH_FIELDS}
+            {k: np.stack([t[k] for t in picked]) for k in BATCH_FIELDS}
         )
-        ready = []
         key, sub = jax.random.split(key)
         state, metrics = train_step(state, batch, sub)
-        if (update + 1) % log_every == 0:
-            mean_rew = float(np.mean(rewards)) if rewards else float("nan")
+        update += 1
+        if switch_at is not None and update == switch_at:
+            cfg = cfg.replace(entropy_coef=float(anneal["coef"]))
+            train_step = jax.jit(spec.make_train_step(cfg, family))
+            print(f"update {update}: entropy_coef -> {cfg.entropy_coef}", flush=True)
+        if update % log_every == 0:
             print(
-                f"update {update+1:4d}  loss {float(metrics['loss']):+.4f}  "
-                f"mean-epi-rew {mean_rew:8.2f}  elapsed {time.time()-t0:5.1f}s"
+                f"update {update:5d}  loss {float(metrics['loss']):+.4f}  "
+                f"mean-epi-rew {mean50():8.2f}  env-steps {env_steps:7d}  "
+                f"elapsed {time.time()-t0:6.1f}s",
+                flush=True,
             )
     env.close()
-    return float(np.mean(rewards)) if rewards else 0.0
+    wallclock = time.time() - t0
+    return {
+        "algo": cfg.algo,
+        "env": cfg.env,
+        "final_mean_50": mean50(),
+        "target": target,
+        "reached_target": hit,
+        "time_to_target_s": (
+            round(time_to_target, 1) if time_to_target is not None else None
+        ),
+        "updates": update,
+        "env_steps": env_steps,
+        "wallclock_s": round(wallclock, 1),
+        "env_steps_per_s": round(env_steps / max(wallclock, 1e-9), 1),
+        "seed": seed,
+    }
+
+
+def main(
+    updates: int = 250,
+    algo: str = "PPO",
+    env_name: str = "CartPole-v1",
+    seed: int = 0,
+    batch_size: int = 32,
+    log_every: int = 25,
+) -> float:
+    """Back-compat wrapper: returns the final 50-game mean episode reward."""
+    stats = run(updates, algo, env_name, seed, batch_size, log_every)
+    mean = stats["final_mean_50"]
+    return mean if np.isfinite(mean) else 0.0
 
 
 if __name__ == "__main__":
@@ -128,6 +217,13 @@ if __name__ == "__main__":
     p.add_argument("--env", default="CartPole-v1")
     p.add_argument("--updates", type=int, default=250)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--target", type=float, default=None)
+    p.add_argument("--batch-size", type=int, default=32)
     args = p.parse_args()
-    final = main(args.updates, args.algo, args.env, args.seed)
-    print(f"final 50-game mean episode reward: {final:.1f}")
+    stats = run(
+        args.updates, args.algo, args.env, args.seed,
+        batch_size=args.batch_size, target=args.target,
+    )
+    import json
+
+    print(json.dumps(stats))

@@ -10,6 +10,9 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import signal  # noqa: E402
+import threading  # noqa: E402
+
 import jax  # noqa: E402
 
 # jax may already have been imported at interpreter start (e.g. a site hook
@@ -20,6 +23,40 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from tpu_rl.config import Config  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout(seconds): fail the test if it exceeds the deadline "
+        "(SIGALRM-based; pytest-timeout is not in this image)",
+    )
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    """Honor @pytest.mark.timeout without the pytest-timeout plugin: a hung
+    cluster test must fail at its deadline, not hang the suite forever."""
+    marker = item.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker and marker.args else 0
+    usable = (
+        seconds > 0
+        and hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+    )
+    if not usable:
+        return (yield)
+
+    def _expired(signum, frame):
+        raise TimeoutError(f"test exceeded timeout marker ({seconds}s)")
+
+    old = signal.signal(signal.SIGALRM, _expired)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(scope="session")

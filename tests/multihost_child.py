@@ -1,0 +1,171 @@
+"""Subprocess body for the real 2-process ``jax.distributed`` test.
+
+Run as: ``python tests/multihost_child.py <process_id> <coordinator_port>``.
+Each process contributes 2 virtual CPU devices -> a 4-device global mesh.
+Validates, with ACTUAL cross-process collectives (gloo):
+
+1. ``tpu_rl.parallel.multihost.init_multihost`` brings up the runtime;
+2. the DP learner feed: ``host_local_batch_to_global`` under ``P("data")``
+   (contiguous-rows assumption) + ``make_parallel_train_step`` over the
+   global mesh == plain single-device jit on the same global batch;
+3. the sequence-parallel feed: ``P("data","seq")`` placement (non-batch
+   index dims preserved — the round-2 fix) + ring attention whose K/V
+   rotation crosses the process boundary == single-device full attention.
+
+Not collected by pytest (no ``test_`` prefix); driven by
+``tests/test_multihost.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    # The TPU plugin here ignores JAX_PLATFORMS (see tpu_rl.utils.platform);
+    # config-force the CPU platform with 2 local devices BEFORE the
+    # distributed runtime starts.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from tpu_rl.parallel.multihost import init_multihost, is_multihost
+
+    init_multihost(
+        coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert is_multihost(), "process_count must be 2 after init_multihost"
+    assert len(jax.devices()) == 4, jax.devices()
+    assert len(jax.local_devices()) == 2
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.config import Config
+    from tpu_rl.parallel.dp import (
+        make_parallel_train_step,
+        make_sp_train_step,
+        replicate,
+    )
+    from tpu_rl.parallel.mesh import batch_sharding, make_mesh
+    from tpu_rl.parallel.multihost import host_local_batch_to_global
+    from tpu_rl.types import BATCH_FIELDS, Batch
+
+    # ---------------- 2. DP path: global batch 8 rows, 4 per host ----------
+    cfg = Config.from_dict(
+        dict(
+            algo="IMPALA", hidden_size=16, seq_len=5, batch_size=8,
+            obs_shape=(4,), action_space=2,
+        )
+    )
+    family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(0))
+
+    rng = np.random.default_rng(0)  # same seed both hosts -> same global batch
+    zb = Batch.zeros(
+        cfg.batch_size, cfg.seq_len, cfg.obs_shape, cfg.action_space,
+        cfg.hidden_size,
+    )
+    global_batch = zb.replace(
+        obs=jnp.asarray(rng.normal(size=zb.obs.shape).astype(np.float32)),
+        act=jnp.asarray(
+            rng.integers(0, 2, size=zb.act.shape).astype(np.float32)
+        ),
+        rew=jnp.asarray(rng.normal(size=zb.rew.shape).astype(np.float32) * 0.1),
+        log_prob=jnp.full(zb.log_prob.shape, -float(np.log(2.0))),
+    )
+    key = jax.random.key(7)
+
+    # Single-device oracle on the full global batch (local jit, cpu:0).
+    s_ref, m_ref = jax.jit(train_step)(state, global_batch, key)
+    loss_ref = float(np.asarray(m_ref["loss"]))
+
+    # DP over the 4-device global mesh, each host feeding its own 4 rows.
+    mesh = make_mesh(4)
+    pstep = make_parallel_train_step(train_step, mesh, cfg)
+    local_rows = {
+        f: np.asarray(getattr(global_batch, f))[pid * 4:(pid + 1) * 4]
+        for f in BATCH_FIELDS
+    }
+    fed = Batch(**host_local_batch_to_global(local_rows, batch_sharding(mesh)))
+    _f2, state2, _t2 = get_algo(cfg.algo).build(cfg, jax.random.key(0))
+    state2 = replicate(state2, mesh)
+    key_r = replicate(key, mesh)
+    s_dp, m_dp = pstep(state2, fed, key_r)
+    loss_dp = float(np.asarray(m_dp["loss"]))
+    assert abs(loss_dp - loss_ref) < 1e-4 * max(1.0, abs(loss_ref)), (
+        loss_dp, loss_ref,
+    )
+
+    # ------------- 3. Seq-sharded path: (data=2, seq=2) mesh, ring ---------
+    from tpu_rl.parallel import make_sp_mesh
+
+    cfg_sp = Config.from_dict(
+        dict(
+            algo="PPO", model="transformer", attention_impl="ring",
+            hidden_size=16, n_heads=2, n_layers=1, seq_len=8, batch_size=4,
+            obs_shape=(4,), action_space=2, mesh_data=2, mesh_seq=2,
+        )
+    )
+    sp_mesh = make_sp_mesh(2, 2)
+    fam_sp, state_sp, step_sp = get_algo("PPO").build(
+        cfg_sp, jax.random.key(1), mesh=sp_mesh
+    )
+    rng2 = np.random.default_rng(1)
+    B, S = cfg_sp.batch_size, cfg_sp.seq_len
+    firsts = np.zeros((B, S, 1), np.float32)
+    firsts[:, 0] = 1.0
+    gb = dict(
+        obs=rng2.normal(size=(B, S, 4)).astype(np.float32),
+        act=rng2.integers(0, 2, size=(B, S, 1)).astype(np.float32),
+        rew=(rng2.normal(size=(B, S, 1)) * 0.1).astype(np.float32),
+        logits=np.zeros((B, S, 2), np.float32),
+        log_prob=np.full((B, S, 1), -float(np.log(2.0)), np.float32),
+        is_fir=firsts,
+        hx=np.zeros((B, S, 1), np.float32),
+        cx=np.zeros((B, S, 1), np.float32),
+    )
+
+    # Single-device oracle: same params, full attention.
+    cfg_full = cfg_sp.replace(attention_impl="full", mesh_data=1, mesh_seq=1)
+    _ff, state_full, step_full = get_algo("PPO").build(
+        cfg_full, jax.random.key(1)
+    )
+    key2 = jax.random.key(9)
+    _sf, m_full = jax.jit(step_full)(
+        state_full, Batch.from_mapping(gb), key2
+    )
+    loss_full = float(np.asarray(m_full["loss"]))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpu_rl.parallel.sequence import DATA_AXIS, SEQ_AXIS
+
+    sp_sharding = NamedSharding(sp_mesh, P(DATA_AXIS, SEQ_AXIS))
+    # Host rows of the (data, seq)-sharded batch: data axis 2 -> 2 rows/host;
+    # trailing (seq) dim stays global-sized locally and is sliced per device
+    # by host_local_batch_to_global (the round-2 fix under test).
+    local_sp = {f: v[pid * 2:(pid + 1) * 2] for f, v in gb.items()}
+    fed_sp = Batch(**host_local_batch_to_global(local_sp, sp_sharding))
+    pstep_sp = make_sp_train_step(step_sp, sp_mesh, cfg_sp)
+    state_sp = replicate(state_sp, sp_mesh)
+    s_sp, m_sp = pstep_sp(state_sp, fed_sp, replicate(key2, sp_mesh))
+    loss_sp = float(np.asarray(m_sp["loss"]))
+    assert abs(loss_sp - loss_full) < 5e-4 * max(1.0, abs(loss_full)), (
+        loss_sp, loss_full,
+    )
+
+    print(
+        f"MULTIHOST_CHILD_OK pid={pid} loss_dp={loss_dp:.6f} "
+        f"loss_sp={loss_sp:.6f}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Real 2-process ``jax.distributed`` test (SURVEY.md §2.4 scaled-backend
+capability): two subprocess "hosts" with 2 virtual CPU devices each bring up
+the distributed runtime via ``tpu_rl.parallel.multihost.init_multihost`` and
+run REAL cross-process collectives — the DP gradient all-reduce and the ring
+attention K/V rotation — validating ``host_local_batch_to_global``'s
+contiguous-rows assumption and the learner's multihost feed against
+single-device oracles. Body: ``tests/multihost_child.py``."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+CHILD = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+
+
+@pytest.mark.timeout(420)
+def test_two_process_distributed_runtime():
+    port = 29950
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(CHILD))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, str(pid), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    deadline = time.time() + 360
+    outs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            remaining = max(5.0, deadline - time.time())
+            outs[i], _ = p.communicate(timeout=remaining)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for i, p in enumerate(procs):
+            if outs[i] is None:
+                try:
+                    outs[i], _ = p.communicate(timeout=10)
+                except Exception:
+                    outs[i] = "<no output>"
+        pytest.fail(
+            "2-process distributed run timed out\n"
+            f"--- pid 0 ---\n{outs[0][-3000:]}\n--- pid 1 ---\n{outs[1][-3000:]}"
+        )
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, (
+            f"child {i} rc={p.returncode}\n{outs[i][-3000:]}"
+        )
+        assert "MULTIHOST_CHILD_OK" in outs[i], outs[i][-3000:]

@@ -73,6 +73,31 @@ def test_local_cluster_end_to_end(tmp_path):
 
 
 @pytest.mark.timeout(300)
+def test_sac_replay_cluster_end_to_end(tmp_path):
+    """Off-policy path as real processes: worker rollouts -> manager ->
+    storage -> seqlock ReplayStore -> SAC learner SAMPLES (not consumes) to
+    N updates, then checkpoints (the reference's second storage mode,
+    agents/learner.py:369-400 + storage_module/shared_batch.py:71-72)."""
+    from tpu_rl.runtime.runner import local_cluster
+
+    cfg = _cluster_cfg(
+        tmp_path, algo="SAC", buffer_size=32, model_save_interval=4
+    )
+    sup = local_cluster(cfg, _machines(29400), max_updates=5)
+    try:
+        learner = next(c for c in sup.children if c.name == "learner")
+        deadline = time.time() + 240
+        while time.time() < deadline and learner.proc.is_alive():
+            time.sleep(1.0)
+        assert not learner.proc.is_alive(), "SAC learner never finished 5 updates"
+        assert learner.proc.exitcode == 0
+        ckpts = os.listdir(tmp_path / "models")
+        assert any(name.startswith("SAC_") for name in ckpts), ckpts
+    finally:
+        sup.stop()
+
+
+@pytest.mark.timeout(300)
 def test_supervisor_restarts_dead_child(tmp_path):
     """Kill a worker; the supervisor must respawn it (the capability the
     reference ships commented out, main.py:417-473)."""
@@ -101,6 +126,58 @@ def test_supervisor_restarts_dead_child(tmp_path):
         assert w.restarts == 1 and w.proc.is_alive()
     finally:
         sup.stop()
+
+
+@pytest.mark.timeout(180)
+def test_worker_warm_start_from_checkpoint(tmp_path):
+    """A worker spawned by worker_role where a checkpoint exists must act with
+    the checkpoint's actor params (reference loads the newest checkpoint into
+    every worker at spawn, main.py:247-252) — verified by recomputing the
+    published behavior logits from the rollout's own (obs, hx, cx) under the
+    checkpointed actor. A random-init worker could not reproduce them."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.checkpoint import Checkpointer
+    from tpu_rl.runtime.protocol import Protocol
+    from tpu_rl.runtime.runner import Supervisor, worker_role
+    from tpu_rl.runtime.transport import Sub
+
+    cfg = _cluster_cfg(tmp_path)
+    family, state, _ = get_algo(cfg.algo).build(cfg, jax.random.key(42))
+    ck = Checkpointer(str(tmp_path / "models"), cfg.algo)
+    ck.save(state, 11)
+    ck.close()
+
+    machines = _machines(29300)
+    machines.workers[0].num_p = 1
+    # Fake manager: bind a SUB where the worker's rollout PUB connects.
+    sub = Sub("127.0.0.1", machines.workers[0].port, bind=True)
+    sup = Supervisor()
+    worker_role(cfg, machines, supervisor=sup)
+    try:
+        msg = None
+        deadline = time.time() + 120
+        while time.time() < deadline and msg is None:
+            got = sub.recv(timeout_ms=1000)
+            if got is not None and got[0] == Protocol.Rollout:
+                msg = got[1]
+        assert msg is not None, "no rollout received from warm-started worker"
+        expected = family.act(
+            {"actor": state.params["actor"]},
+            jnp.asarray(msg["obs"], jnp.float32)[None],
+            jnp.asarray(msg["hx"], jnp.float32)[None],
+            jnp.asarray(msg["cx"], jnp.float32)[None],
+            jax.random.key(0),
+        )[1]
+        np.testing.assert_allclose(
+            np.asarray(msg["logits"]), np.asarray(expected[0]),
+            rtol=1e-5, atol=1e-6,
+        )
+    finally:
+        sup.stop()
+        sub.close()
 
 
 @pytest.mark.timeout(120)

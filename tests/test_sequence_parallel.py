@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from tpu_rl.parallel.sequence import (
     SEQ_AXIS,
@@ -46,7 +46,6 @@ def _sharded_attn(impl, mesh, n_seq):
         mesh=mesh,
         in_specs=(qspec, qspec, qspec, spec, spec),
         out_specs=qspec,
-        check_rep=False,
     )
     def fn(q, k, v, pos, seg):
         return impl(q, k, v, pos, seg, axis_name=SEQ_AXIS, causal=True)

@@ -167,38 +167,122 @@ class TestTransformerActing:
         fam = build_family(cfg)
         params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
         act = jax.jit(fam.act)
-        ctx, obs_dim = cfg.effective_act_ctx, 4
-        h = jnp.zeros((1, ctx * obs_dim))
-        c = jnp.zeros((1, 1))
+        h = jnp.zeros((1, fam.carry_widths[0]))
+        c = jnp.zeros((1, fam.carry_widths[1]))
         for t in range(12):
-            obs = jnp.asarray(rng.normal(size=(1, obs_dim)).astype(np.float32))
+            obs = jnp.asarray(rng.normal(size=(1, 4)).astype(np.float32))
             a, logits, log_prob, h, c = act(params, obs, h, c, jax.random.key(t))
             assert a.shape == (1, 1)
             assert logits.shape == (1, 2)
             assert np.isfinite(np.asarray(logits)).all()
-        assert float(c[0, 0]) == 8.0  # counter saturates at ctx
+        assert float(c[0, -1]) == 12.0  # step counter (KV ring handles > ctx)
 
     def test_act_ignores_padding(self, rng):
-        """With 1 valid step, logits must not depend on stale history bytes."""
+        """With 0 cached steps, logits must not depend on stale cache bytes."""
         cfg = _tf_config(act_ctx=8)
         fam = build_family(cfg)
         params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
         obs = jnp.asarray(rng.normal(size=(1, 4)).astype(np.float32))
-        c0 = jnp.zeros((1, 1))
-        h_zero = jnp.zeros((1, 8 * 4))
-        h_junk = jnp.asarray(rng.normal(size=(1, 8 * 4)).astype(np.float32))
+        kv, kv1 = fam.carry_widths
+        c0 = jnp.zeros((1, kv1))
+        h_zero = jnp.zeros((1, kv))
+        h_junk = jnp.asarray(rng.normal(size=(1, kv)).astype(np.float32))
+        # junk V caches too (their counter stays 0 = nothing valid)
+        c_junk = c0.at[:, :-1].set(
+            jnp.asarray(rng.normal(size=(kv1 - 1,)).astype(np.float32))
+        )
         _, l1, _, _, _ = fam.act(params, obs, h_zero, c0, jax.random.key(0))
-        _, l2, _, _, _ = fam.act(params, obs, h_junk, c0, jax.random.key(0))
+        _, l2, _, _, _ = fam.act(params, obs, h_junk, c_junk, jax.random.key(0))
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
 
+    def test_kv_cache_matches_window_recompute(self, rng):
+        """The KV-cached acting path must reproduce the full-window recompute
+        path exactly (float tolerance) for every step of an episode that fits
+        the context window — the O(ctx·d) vs O(ctx²·d) redesign changes cost,
+        not math."""
+        from functools import partial
+
+        from tpu_rl.models.families import _act_transformer_window
+
+        cfg = _tf_config(act_ctx=8)
+        ctx, obs_dim = cfg.effective_act_ctx, 4
+        fam = build_family(cfg)
+        params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        act_kv = jax.jit(fam.act)
+        act_win = jax.jit(
+            partial(_act_transformer_window, fam.actor, ctx, obs_dim)
+        )
+        h_kv = jnp.zeros((1, fam.carry_widths[0]))
+        c_kv = jnp.zeros((1, fam.carry_widths[1]))
+        h_w = jnp.zeros((1, ctx * obs_dim))
+        c_w = jnp.zeros((1, 1))
+        for t in range(ctx):  # full window-length episode
+            obs = jnp.asarray(rng.normal(size=(1, obs_dim)).astype(np.float32))
+            k = jax.random.key(100 + t)
+            a1, l1, lp1, h_kv, c_kv = act_kv(params, obs, h_kv, c_kv, k)
+            a2, l2, lp2, h_w, c_w = act_win(params, obs, h_w, c_w, k)
+            np.testing.assert_allclose(
+                np.asarray(l1), np.asarray(l2), rtol=1e-5, atol=1e-5
+            )
+            np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+
+    def test_kv_cache_is_cheaper(self):
+        """Compiled FLOPs of one cached acting step must be far below the
+        window-recompute step at long context (the point of the redesign)."""
+        from functools import partial
+
+        from tpu_rl.models.families import _act_transformer_window
+
+        cfg = _tf_config(act_ctx=256, seq_len=16)
+        ctx, obs_dim = cfg.effective_act_ctx, 4
+        fam = build_family(cfg)
+        params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        obs = jnp.zeros((1, obs_dim))
+        key = jax.random.key(0)
+
+        def flops(fn, h, c):
+            lowered = jax.jit(fn).lower(params, obs, h, c, key)
+            cost = lowered.compile().cost_analysis()
+            return cost.get("flops", 0.0) if cost else 0.0
+
+        f_kv = flops(
+            fam.act,
+            jnp.zeros((1, fam.carry_widths[0])),
+            jnp.zeros((1, fam.carry_widths[1])),
+        )
+        f_win = flops(
+            partial(_act_transformer_window, fam.actor, ctx, obs_dim),
+            jnp.zeros((1, ctx * obs_dim)),
+            jnp.zeros((1, 1)),
+        )
+        if not (f_kv and f_win):
+            pytest.skip("backend reports no FLOPs cost analysis")
+        assert f_kv < f_win / 20, (f_kv, f_win)
+
+    def test_bf16_kv_decode_runs(self, rng):
+        """bf16 compute must compose with the float32 carry caches (the
+        projections are cast back before the cache slice update)."""
+        cfg = _tf_config(act_ctx=8, compute_dtype="bfloat16")
+        fam = build_family(cfg)
+        params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        act = jax.jit(fam.act)
+        h = jnp.zeros((1, fam.carry_widths[0]))
+        c = jnp.zeros((1, fam.carry_widths[1]))
+        for t in range(3):
+            obs = jnp.asarray(rng.normal(size=(1, 4)).astype(np.float32))
+            _a, logits, _lp, h, c = act(params, obs, h, c, jax.random.key(t))
+            assert np.isfinite(np.asarray(logits)).all()
+        assert h.dtype == jnp.float32 and c.dtype == jnp.float32
+
     def test_worker_batch_layout_roundtrip(self):
-        """Transformer batches ship 1-float carry placeholders (the acting
-        window stays worker-local); the family knows the real carry widths."""
+        """Transformer batches ship 1-float carry placeholders (the KV caches
+        stay worker-local); the family knows the real carry widths."""
         from tpu_rl.data.layout import BatchLayout
 
         cfg = _tf_config(act_ctx=8)
         lay = BatchLayout.from_config(cfg)
         assert lay.hx == 1 and lay.cx == 1
         fam = build_family(cfg)
-        assert fam.carry_widths == (8 * 4, 1)
+        kv = cfg.n_layers * 8 * cfg.hidden_size
+        assert fam.carry_widths == (kv, kv + 1)
         assert not fam.store_carry

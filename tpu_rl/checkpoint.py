@@ -33,6 +33,32 @@ def _ckpt_dirs(model_dir: str, algo: str) -> list[tuple[int, str]]:
     return sorted(out)
 
 
+def restore_actor_params(model_dir: str, algo: str):
+    """Actor parameter tree of the NEWEST checkpoint, as host numpy arrays
+    wrapped ``{"actor": ...}`` (the worker acting contract), or None when no
+    checkpoint exists.
+
+    This is the worker warm-start path: the reference loads the newest
+    checkpoint into every worker at spawn (``/root/reference/main.py:247-252``
+    via the newest-file scan ``:128-146``) so actors start from the trained
+    policy instead of random init. Template-free raw restore: callers (the
+    worker role) don't build a learner train state just to know its structure.
+    """
+    found = _ckpt_dirs(os.path.abspath(model_dir), algo)
+    if not found:
+        return None
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckpt:
+        raw = ckpt.restore(found[-1][1])
+    # TrainState nests under "params"/"actor"; SACState keeps "actor_params".
+    params = raw.get("params")
+    actor = params.get("actor") if isinstance(params, dict) else None
+    if actor is None:
+        actor = raw.get("actor_params")
+    return {"actor": actor} if actor is not None else None
+
+
 class Checkpointer:
     def __init__(self, model_dir: str, algo: str, keep: int = 5):
         self.model_dir = os.path.abspath(model_dir)

@@ -98,13 +98,20 @@ class OnPolicyStore(_StoreBase):
     """Fill-then-consume batch store (single writer, single reader)."""
 
     # ---------------------------------------------------------------- writer
+    # put() retry bound: a consume can reset the store mid-write, forcing a
+    # re-write into the new generation; each retry needs a fresh consume to
+    # intervene (which itself needs a full store), so in practice one retry
+    # suffices. The cap makes the no-livelock contract explicit.
+    MAX_PUT_RETRIES = 8
+
     def put(self, window: dict) -> bool:
         """Write one (seq, width)-per-field trajectory window. Returns False
         when the current generation is full (caller drops or retries later,
         matching the reference's ``num < mem_size`` guard,
-        ``learner_storage.py:139``)."""
+        ``learner_storage.py:139``) or — bounded-retry contract — when
+        consumes keep invalidating the write ``MAX_PUT_RETRIES`` times."""
         h = self.h
-        while True:
+        for _ in range(self.MAX_PUT_RETRIES):
             with h.lock:
                 gen, slot = h.gen.value, h.count.value
                 if slot >= self.capacity:
@@ -117,6 +124,7 @@ class OnPolicyStore(_StoreBase):
                     return True
             # A consume reset the store mid-write; re-write into the new
             # generation (this is the race the reference ignores).
+        return False
 
     # ---------------------------------------------------------------- reader
     @property

@@ -114,20 +114,48 @@ def _act_sac_discrete(actor: SACDiscreteActor, params, obs, h, c, key):
     return a[..., None].astype(jnp.float32), logits, log_prob[..., None], h2, c2
 
 
-def _act_transformer(actor, ctx: int, obs_dim: int, params, obs, h, c, key):
-    """Sliding-window acting for the transformer family.
+def _act_transformer(
+    actor, ctx: int, n_layers: int, n_heads: int, hidden: int,
+    params, obs, h, c, key,
+):
+    """KV-cached incremental acting for the transformer family: O(ctx·d + d²)
+    per env step instead of the O(ctx²·d) full-window recompute
+    (``_act_transformer_window``, kept as the equivalence oracle).
 
-    The carry reuses the (hx, cx) plumbing: ``h`` is the flattened history of
-    the last ``ctx`` observations (newest last), ``c`` is a 1-float counter of
-    valid steps this episode. The worker zeroes both at episode starts, which
-    empties the window — no state crosses episodes. Inside an episode longer
-    than ``ctx`` the policy attends over the newest ``ctx`` steps.
+    The carry reuses the (hx, cx) plumbing: ``h`` is the flattened per-layer
+    K caches (n_layers · ctx · hidden), ``c`` is the flattened V caches plus a
+    trailing 1-float step counter. The worker zeroes both at episode starts,
+    which empties the caches — no state crosses episodes. Positions are
+    episode-relative, matching the training unroll's segment-relative
+    positions, so behavior and training policies agree exactly while an
+    episode fits one window (``tests/test_transformer.py`` asserts bit-level
+    agreement with the window path); beyond ``ctx`` the ring-buffer keeps each
+    token's K/V as originally computed — a policy-lag-like bias absorbed by
+    the IS/V-trace corrections."""
+    head_d = hidden // n_heads
+    k_caches = h.reshape(1, n_layers, ctx, n_heads, head_d)
+    v_caches = c[:, :-1].reshape(1, n_layers, ctx, n_heads, head_d)
+    count = c[0, -1].astype(jnp.int32)
+    logits, _value, k2, v2 = actor.apply(
+        params["actor"], obs, k_caches, v_caches, count, method="decode"
+    )
+    a = D.categorical_sample(key, logits)
+    log_prob = D.categorical_log_prob(logits, a)
+    h2 = k2.reshape(1, -1)
+    c2 = jnp.concatenate(
+        [v2.reshape(1, -1), (count + 1).astype(jnp.float32)[None, None]], axis=1
+    )
+    return a[..., None].astype(jnp.float32), logits, log_prob[..., None], h2, c2
 
-    Positions are episode-relative (0 at the episode start), matching the
-    training unroll's segment-relative positions, so behavior and training
-    policies agree exactly while an episode fits one window; beyond that the
-    sliding window truncates context the training unroll restarts — a
-    policy-lag-like bias absorbed by the IS/V-trace corrections."""
+
+def _act_transformer_window(
+    actor, ctx: int, obs_dim: int, params, obs, h, c, key
+):
+    """Full-window recompute acting (the pre-KV-cache path): ``h`` is the
+    flattened history of the last ``ctx`` observations (newest last), ``c`` a
+    1-float counter of valid steps. O(ctx²·d) per step — kept as the
+    equivalence oracle for ``_act_transformer`` and for contexts where window
+    re-positioning (exact sliding semantics) matters more than speed."""
     hist = h.reshape(1, ctx, obs_dim)
     hist = jnp.concatenate([hist[:, 1:], obs[:, None, :]], axis=1)
     n_valid = jnp.minimum(c[0, 0] + 1.0, float(ctx))
@@ -179,12 +207,16 @@ def build_family(cfg: Config, mesh=None) -> ModelFamily:
             mesh=mesh,
             dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None,
         )
+        ctx = cfg.effective_act_ctx
+        kv = cfg.n_layers * ctx * cfg.hidden_size
         fam = ModelFamily(
             cfg.algo, False, False, actor, None, obs_dim, n, cfg.hidden_size,
             act=partial(
-                _act_transformer, actor, cfg.effective_act_ctx, obs_dim
+                _act_transformer, actor, ctx, cfg.n_layers, cfg.n_heads,
+                cfg.hidden_size,
             ),
-            act_carry_widths=(cfg.effective_act_ctx * obs_dim, 1),
+            # h = K caches; c = V caches + step counter (see _act_transformer).
+            act_carry_widths=(kv, kv + 1),
             store_carry=False,
         )
         return fam

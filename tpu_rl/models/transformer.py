@@ -16,6 +16,17 @@ XLA partitions the elementwise/Dense compute from the batch sharding while the
 ring rotates K/V blocks over ICI. Episode seams (``is_fir``) become attention
 segment masks, computed globally before sharding, so no token attends across
 an episode boundary.
+
+Acting uses ``decode`` — incremental decoding with per-layer K/V caches — so a
+worker env step costs O(ctx·d + d²) instead of the O(ctx²·d) full-window
+recompute (the reference's acting path is a single LSTM step,
+``/root/reference/networks/models.py:37-56``; this is its transformer
+equivalent). For episodes that fit the context window the cached and
+full-recompute paths are numerically equivalent (``tests/test_transformer.py``);
+past the window the cache keeps each token's K/V as computed when it entered
+(sliding re-positioning is impossible without recompute) — a policy-lag-like
+bias absorbed by the IS/V-trace corrections, same as the window path's
+truncation bias.
 """
 
 from __future__ import annotations
@@ -33,6 +44,7 @@ from tpu_rl.parallel.sequence import (
     ATTENTION_IMPLS,
     DATA_AXIS,
     SEQ_AXIS,
+    full_attention,
     segment_ids_from_firsts,
 )
 
@@ -51,21 +63,25 @@ def sinusoidal_embedding(pos: jax.Array, dim: int) -> jax.Array:
 
 class MultiHeadAttention(nn.Module):
     """Causal segment-masked MHA with a pluggable (possibly sequence-sharded)
-    attention primitive."""
+    attention primitive, plus a single-token cached decode path."""
 
+    hidden: int
     n_heads: int
     attention_impl: str = "full"  # full | ring | ulysses
     mesh: Any = None  # jax Mesh when impl is sharded
     dtype: Any = None  # computation dtype (bfloat16 feeds the MXU natively)
 
-    @nn.compact
+    def setup(self):
+        assert self.hidden % self.n_heads == 0, (
+            f"d_model {self.hidden} not divisible by heads {self.n_heads}"
+        )
+        self.qkv = nn.Dense(3 * self.hidden, name="qkv", dtype=self.dtype)
+        self.out = nn.Dense(self.hidden, name="out", dtype=self.dtype)
+
     def __call__(self, x: jax.Array, pos: jax.Array, seg: jax.Array):
         B, T, C = x.shape
         H = self.n_heads
-        assert C % H == 0, f"d_model {C} not divisible by heads {H}"
-        qkv = nn.Dense(3 * C, name="qkv", dtype=self.dtype)(x).reshape(
-            B, T, 3, H, C // H
-        )
+        qkv = self.qkv(x).reshape(B, T, 3, H, C // H)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         impl = ATTENTION_IMPLS[self.attention_impl]
         # Shapes are static under tracing: only enter the shard_map island
@@ -87,30 +103,76 @@ class MultiHeadAttention(nn.Module):
             )
             o = attn(q, k, v, pos, seg)
         else:
-            from tpu_rl.parallel.sequence import full_attention
-
             o = full_attention(q, k, v, pos, seg, causal=True)
-        return nn.Dense(C, name="out", dtype=self.dtype)(o.reshape(B, T, C))
+        return self.out(o.reshape(B, T, C))
+
+    def decode(
+        self,
+        x_t: jax.Array,  # (B, 1, C) — the newest token only
+        k_cache: jax.Array,  # (B, ctx, H, D)
+        v_cache: jax.Array,  # (B, ctx, H, D)
+        count: jax.Array,  # scalar int32: tokens already cached this episode
+    ):
+        """One incremental step: project the new token, ring-write its K/V
+        into the cache at ``count % ctx``, attend the query over the valid
+        cache entries. All cached tokens precede the query, so causality is
+        exactly the validity mask."""
+        B, _, C = x_t.shape
+        H = self.n_heads
+        ctx = k_cache.shape[1]
+        qkv = self.qkv(x_t).reshape(B, 1, 3, H, C // H)
+        q, k_new, v_new = qkv[:, 0, 0], qkv[:, 0, 1], qkv[:, 0, 2]  # (B,H,D)
+        # The worker carry (and thus the caches) is float32; under bf16
+        # compute the projections must be cast back before the slice update.
+        k_new = k_new.astype(k_cache.dtype)
+        v_new = v_new.astype(v_cache.dtype)
+        q = q.astype(k_cache.dtype)
+        slot = jnp.mod(count, ctx)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k_new[:, None], slot, axis=1
+        )
+        v_cache = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v_new[:, None], slot, axis=1
+        )
+        valid = jnp.arange(ctx) <= count  # ring not yet wrapped: prefix only
+        scores = jnp.einsum("bhd,bthd->bht", q, k_cache) / np.sqrt(C / H)
+        scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", w, v_cache)
+        return self.out(o.reshape(B, 1, C)), k_cache, v_cache
 
 
 class Block(nn.Module):
+    hidden: int
     n_heads: int
     ff_mult: int = 4
     attention_impl: str = "full"
     mesh: Any = None
     dtype: Any = None
 
-    @nn.compact
+    def setup(self):
+        self.attn = MultiHeadAttention(
+            self.hidden, self.n_heads, self.attention_impl, self.mesh,
+            self.dtype, name="attn",
+        )
+        self.ln1 = nn.LayerNorm(name="ln1")
+        self.ln2 = nn.LayerNorm(name="ln2")
+        self.ff1 = nn.Dense(self.ff_mult * self.hidden, name="ff1", dtype=self.dtype)
+        self.ff2 = nn.Dense(self.hidden, name="ff2", dtype=self.dtype)
+
+    def _ff(self, x):
+        return self.ff2(nn.gelu(self.ff1(self.ln2(x))))
+
     def __call__(self, x, pos, seg):
-        a = MultiHeadAttention(
-            self.n_heads, self.attention_impl, self.mesh, self.dtype,
-            name="attn",
-        )(nn.LayerNorm(name="ln1")(x), pos, seg)
-        x = x + a
-        h = nn.LayerNorm(name="ln2")(x)
-        h = nn.Dense(self.ff_mult * x.shape[-1], name="ff1", dtype=self.dtype)(h)
-        h = nn.Dense(x.shape[-1], name="ff2", dtype=self.dtype)(nn.gelu(h))
-        return x + h
+        x = x + self.attn(self.ln1(x), pos, seg)
+        return x + self._ff(x)
+
+    def decode(self, x_t, k_cache, v_cache, count):
+        a, k_cache, v_cache = self.attn.decode(
+            self.ln1(x_t), k_cache, v_cache, count
+        )
+        x_t = x_t + a
+        return x_t + self._ff(x_t), k_cache, v_cache
 
 
 class TransformerActorCritic(nn.Module):
@@ -133,7 +195,30 @@ class TransformerActorCritic(nn.Module):
     reset_on_first: bool = True  # interface parity; attention always resets
     # via segment masking (a transformer cannot "carry state across seams")
 
-    @nn.compact
+    def setup(self):
+        self.embed = nn.Dense(self.hidden, name="embed", dtype=self.dtype)
+        self.blocks = [
+            Block(
+                self.hidden,
+                self.n_heads,
+                self.ff_mult,
+                self.attention_impl,
+                self.mesh,
+                self.dtype,
+                name=f"block{i}",
+            )
+            for i in range(self.n_layers)
+        ]
+        self.ln_f = nn.LayerNorm(name="ln_f")
+        self.logits_head = nn.Dense(self.n_actions, name="logits")
+        self.value_head = nn.Dense(1, name="value")
+
+    def _heads(self, x):
+        h = self.ln_f(x)
+        # Heads in float32: log-probs and values feed loss math directly.
+        h = h.astype(jnp.float32)
+        return jax.nn.log_softmax(self.logits_head(h)), self.value_head(h)
+
     def __call__(
         self,
         obs: jax.Array,
@@ -156,22 +241,40 @@ class TransformerActorCritic(nn.Module):
                 jnp.where(firsts[..., 0] > 0, idx, 0), axis=1
             )
             pos = idx - seam
-        x = nn.Dense(self.hidden, name="embed", dtype=self.dtype)(obs)
+        x = self.embed(obs)
         x = x + sinusoidal_embedding(pos, self.hidden).astype(x.dtype)
-        for i in range(self.n_layers):
-            x = Block(
-                self.n_heads,
-                self.ff_mult,
-                self.attention_impl,
-                self.mesh,
-                self.dtype,
-                name=f"block{i}",
-            )(x, pos, seg)
-        h = nn.LayerNorm(name="ln_f")(x)
-        # Heads in float32: log-probs and values feed loss math directly.
-        h = h.astype(jnp.float32)
-        logits = jax.nn.log_softmax(nn.Dense(self.n_actions, name="logits")(h))
-        value = nn.Dense(1, name="value")(h)
+        for block in self.blocks:
+            x = block(x, pos, seg)
+        logits, value = self._heads(x)
         return logits, value, carry0
 
     unroll = __call__
+
+    def decode(
+        self,
+        obs_t: jax.Array,  # (B, obs_dim) — the newest observation
+        k_caches: jax.Array,  # (B, n_layers, ctx, H, D)
+        v_caches: jax.Array,  # (B, n_layers, ctx, H, D)
+        count: jax.Array,  # scalar int32: tokens already cached this episode
+    ):
+        """Incremental acting step. The position is episode-relative
+        (= ``count``), matching the training unroll's segment-relative
+        positions while the episode fits the window."""
+        B = obs_t.shape[0]
+        pos = jnp.full((B, 1), count, jnp.int32)
+        x = self.embed(obs_t[:, None, :])
+        x = x + sinusoidal_embedding(pos, self.hidden).astype(x.dtype)
+        new_k, new_v = [], []
+        for i, block in enumerate(self.blocks):
+            x, k_i, v_i = block.decode(
+                x, k_caches[:, i], v_caches[:, i], count
+            )
+            new_k.append(k_i)
+            new_v.append(v_i)
+        logits, value = self._heads(x)
+        return (
+            logits[:, 0],
+            value[:, 0],
+            jnp.stack(new_k, axis=1),
+            jnp.stack(new_v, axis=1),
+        )

@@ -93,5 +93,16 @@ def shard_batch(batch: Batch, mesh) -> Batch:
 
 
 def replicate(tree: Any, mesh) -> Any:
-    """Replicate a host pytree (train state, RNG key) onto every mesh device."""
-    return jax.device_put(tree, replicated(mesh))
+    """Replicate a host pytree (train state, RNG key) onto every mesh device.
+
+    On a multi-process mesh ``jax.device_put`` refuses committed host-local
+    arrays (the sharding spans non-addressable devices); route through an
+    SPMD identity jit with global ``out_shardings`` instead — valid because
+    every host holds identical values by construction (same seed or the same
+    restored checkpoint; ``tests/multihost_child.py`` exercises this with a
+    real 2-process runtime)."""
+    rs = replicated(mesh)
+    local = jax.process_index()
+    if all(d.process_index == local for d in mesh.devices.flat):
+        return jax.device_put(tree, rs)
+    return jax.jit(lambda t: t, out_shardings=rs)(tree)

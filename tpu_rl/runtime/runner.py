@@ -258,11 +258,23 @@ def worker_role(
 
     sup = supervisor or Supervisor()
     m = machines.workers[machine_idx]
+    # Warm-start every worker from the newest checkpoint when one exists
+    # (reference ``main.py:247-252``: the newest saved model is loaded into
+    # each worker before spawn). Loaded once here, shared by all num_p
+    # children; workers without a checkpoint start from random init and catch
+    # the learner's first broadcast.
+    initial_params = None
+    if cfg.model_dir:
+        from tpu_rl.checkpoint import restore_actor_params
+
+        initial_params = restore_actor_params(cfg.model_dir, cfg.algo)
     for i in range(m.num_p):
         sup.spawn(
             f"worker-{machine_idx}-{i}",
             functools.partial(
-                worker_main, seed=seed * 1000 + machine_idx * 100 + i
+                worker_main,
+                seed=seed * 1000 + machine_idx * 100 + i,
+                initial_params=initial_params,
             ),
             cfg,
             i,
