@@ -1,0 +1,99 @@
+"""On-chip kernel-vs-scan benchmark for the fused Pallas LSTM.
+
+Times the LSTM sequence unroll (forward and forward+grad) with the Pallas
+kernel (``set_pallas_mode("auto")``) against the ``lax.scan`` path
+(``"off"``), at the reference batch quantum and at MXU-loading widths —
+including shapes whose batch is grid-tiled over VMEM (``batch_tile``).
+
+Run on the TPU (no JAX_PLATFORMS override):
+  PYTHONPATH=/root/repo python examples/bench_lstm_kernel.py
+
+Writes ``bench_lstm_kernel.json`` and prints one row per (shape, pass).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_rl.models import cells
+from tpu_rl.models.cells import LSTMCell
+from tpu_rl.ops.pallas_lstm import batch_tile
+
+SHAPES = [
+    # (B, S, IN, H, iters) — reference quantum, mid, wide (grid-tiled)
+    (128, 5, 4, 64, 300),
+    (256, 16, 64, 256, 100),
+    (1024, 16, 64, 1024, 30),
+]
+
+
+def _run(cell, params, x, firsts, carry0, mode: str, grad: bool, iters: int):
+    def fwd(params, x):
+        cells.set_pallas_mode(mode)
+        try:
+            (hN, cN), hs = cell.apply(
+                params, x, carry0, firsts, True, method=LSTMCell.unroll
+            )
+        finally:
+            cells.set_pallas_mode("auto")
+        return (hs**2).mean() + (hN + cN).mean()
+
+    fn = jax.jit(jax.grad(fwd) if grad else fwd)
+    out = fn(params, x)  # compile
+    jax.block_until_ready(out)
+    # device_get forces true chain completion (see bench.py _sync note)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(params, x)
+    np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    ).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    rows = []
+    for B, S, IN, H, iters in SHAPES:
+        rng = np.random.default_rng(0)
+        cell = LSTMCell(H)
+        x = jnp.asarray(rng.normal(size=(B, S, IN)).astype(np.float32))
+        firsts = np.zeros((B, S, 1), np.float32)
+        firsts[:, 0] = 1.0
+        firsts = jnp.asarray(firsts)
+        carry0 = (jnp.zeros((B, H)), jnp.zeros((B, H)))
+        params = cell.init(jax.random.key(0), (carry0[0], carry0[1]), x[:, 0])
+        for grad in (False, True):
+            t_scan = _run(cell, params, x, firsts, carry0, "off", grad, iters)
+            t_kern = _run(cell, params, x, firsts, carry0, "auto", grad, iters)
+            row = {
+                "shape": f"B{B} S{S} H{H}",
+                "pass": "fwd+grad" if grad else "fwd",
+                "batch_tile": batch_tile(B, S, H),
+                "scan_ms": round(t_scan * 1e3, 3),
+                "kernel_ms": round(t_kern * 1e3, 3),
+                "speedup": round(t_scan / t_kern, 2),
+                "tokens_per_s_kernel": round(B * S / t_kern, 1),
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    out = {
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "rows": rows,
+    }
+    with open("bench_lstm_kernel.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote bench_lstm_kernel.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,7 @@ CONFIGS: dict[str, dict] = {
         algo="IMPALA", env_name="CartPole-v1", target=500.0,
         overrides=dict(
             entropy_coef=0.001,
-            entropy_anneal={"coef": 5e-5, "frac": 0.4},
+            entropy_anneal={"coef": 5e-5, "lr": 1e-4, "frac": 0.4},
         ),
     ),
     "V-MPO": dict(

@@ -66,11 +66,14 @@ def run(
         time_horizon=500,
     )
     overrides = dict(overrides or {})
-    # Two-phase entropy schedule: {"coef": final, "frac": 0.5} switches the
-    # entropy bonus to ``coef`` after ``frac`` of the update budget — high
-    # early exploration, then a near-deterministic tail so capped-return
-    # targets (CartPole 500 = every step of every episode) are reachable.
-    # One extra jit compile at the boundary; everything else is unchanged.
+    # Two-phase schedule: {"coef": final_entropy, "lr": final_lr, "frac": 0.5}
+    # switches the entropy bonus (and optionally the learning rate) after
+    # ``frac`` of the update budget — high early exploration, then a
+    # near-deterministic low-variance tail so capped-return targets
+    # (CartPole 500 = every step of every episode) are reachable without the
+    # late policy collapse a hot lr + cold entropy invites. One extra jit
+    # compile at the boundary; the optimizer state carries over (adam moments
+    # are lr-independent).
     anneal = overrides.pop("entropy_anneal", None)
     cfg_dict.update(overrides)
     cfg = probe_spaces(Config.from_dict(cfg_dict))
@@ -168,9 +171,16 @@ def run(
         state, metrics = train_step(state, batch, sub)
         update += 1
         if switch_at is not None and update == switch_at:
-            cfg = cfg.replace(entropy_coef=float(anneal["coef"]))
+            cfg = cfg.replace(
+                entropy_coef=float(anneal["coef"]),
+                lr=float(anneal.get("lr", cfg.lr)),
+            )
             train_step = jax.jit(spec.make_train_step(cfg, family))
-            print(f"update {update}: entropy_coef -> {cfg.entropy_coef}", flush=True)
+            print(
+                f"update {update}: entropy_coef -> {cfg.entropy_coef}, "
+                f"lr -> {cfg.lr}",
+                flush=True,
+            )
         if update % log_every == 0:
             print(
                 f"update {update:5d}  loss {float(metrics['loss']):+.4f}  "

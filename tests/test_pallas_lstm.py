@@ -141,8 +141,58 @@ def test_dp_mesh_shard_map_island(devices, rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_batch_tiled_grid_matches_scan(rng, monkeypatch):
+    """With a VMEM budget too small for the whole batch, the kernel must run
+    as a multi-tile Pallas grid and still match the scan exactly."""
+    import tpu_rl.ops.pallas_lstm as pk
+
+    B, S, IN, H = 32, 6, 5, 16
+    cell = LSTMCell(H)
+    x = jnp.asarray(rng.normal(size=(B, S, IN)).astype(np.float32))
+    firsts = np.zeros((B, S, 1), np.float32)
+    firsts[:, 0] = 1.0
+    firsts[1, 3] = 1.0
+    firsts = jnp.asarray(firsts)
+    carry0 = (
+        jnp.asarray(rng.normal(size=(B, H)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(B, H)).astype(np.float32)),
+    )
+    params = cell.init(jax.random.key(0), carry0, x[:, 0])
+    # Budget fits an 8-row tile but not 16 or the whole batch -> grid of 4.
+    monkeypatch.setattr(pk, "_VMEM_BUDGET_BYTES", 40000)
+    assert pk.batch_tile(B, S, H) == 8
+
+    def loss(params, x, carry0, mode):
+        cells.set_pallas_mode(mode)
+        try:
+            (hN, cN), hs = _unroll(cell, params, x, carry0, firsts, True)
+        finally:
+            cells.set_pallas_mode("auto")
+        return (hs**2).sum() + (hN * 0.5).sum() + (cN * 0.25).sum()
+
+    v_scan, g_scan = jax.value_and_grad(loss, argnums=(0, 1))(
+        params, x, carry0, "off"
+    )
+    v_kern, g_kern = jax.value_and_grad(loss, argnums=(0, 1))(
+        params, x, carry0, "interpret"
+    )
+    np.testing.assert_allclose(float(v_kern), float(v_scan), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_kern), jax.tree_util.tree_leaves(g_scan)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
 def test_vmem_budget_fallback():
-    from tpu_rl.ops.pallas_lstm import fits_vmem
+    from tpu_rl.ops.pallas_lstm import batch_tile, fits_vmem
 
     assert fits_vmem(128, 5, 64)
     assert not fits_vmem(128, 4096, 256)  # long-context: transformer's job
+    # The wide bench workload tiles instead of falling back...
+    bt = batch_tile(1024, 16, 1024)
+    assert bt is not None and 1024 % bt == 0 and bt % 8 == 0
+    # ...but a long-context shape whose only fitting tiles are degenerate
+    # (< 8 rows: serialized over the grid, worse than the scan) must refuse,
+    assert batch_tile(128, 4096, 256) is None
+    # ...as must a workload whose weights alone bust VMEM.
+    assert batch_tile(8, 4096, 2048) is None

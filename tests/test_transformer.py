@@ -195,16 +195,17 @@ class TestTransformerActing:
         _, l2, _, _, _ = fam.act(params, obs, h_junk, c_junk, jax.random.key(0))
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-5)
 
-    def test_kv_cache_matches_window_recompute(self, rng):
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_kv_cache_matches_window_recompute(self, rng, dtype):
         """The KV-cached acting path must reproduce the full-window recompute
-        path exactly (float tolerance) for every step of an episode that fits
-        the context window — the O(ctx·d) vs O(ctx²·d) redesign changes cost,
-        not math."""
+        path (float tolerance; bf16 within mixed-precision rounding) for
+        every step of an episode that fits the context window — the O(ctx·d)
+        vs O(ctx²·d) redesign changes cost, not math."""
         from functools import partial
 
         from tpu_rl.models.families import _act_transformer_window
 
-        cfg = _tf_config(act_ctx=8)
+        cfg = _tf_config(act_ctx=8, compute_dtype=dtype)
         ctx, obs_dim = cfg.effective_act_ctx, 4
         fam = build_family(cfg)
         params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
@@ -216,15 +217,17 @@ class TestTransformerActing:
         c_kv = jnp.zeros((1, fam.carry_widths[1]))
         h_w = jnp.zeros((1, ctx * obs_dim))
         c_w = jnp.zeros((1, 1))
+        tol = dict(rtol=1e-5, atol=1e-5) if dtype == "float32" else dict(
+            rtol=0.05, atol=0.03
+        )
         for t in range(ctx):  # full window-length episode
             obs = jnp.asarray(rng.normal(size=(1, obs_dim)).astype(np.float32))
             k = jax.random.key(100 + t)
             a1, l1, lp1, h_kv, c_kv = act_kv(params, obs, h_kv, c_kv, k)
             a2, l2, lp2, h_w, c_w = act_win(params, obs, h_w, c_w, k)
-            np.testing.assert_allclose(
-                np.asarray(l1), np.asarray(l2), rtol=1e-5, atol=1e-5
-            )
-            np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+            np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), **tol)
+            if dtype == "float32":
+                np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
 
     def test_kv_cache_is_cheaper(self):
         """Compiled FLOPs of one cached acting step must be far below the

@@ -53,7 +53,7 @@ def _use_pallas(
     ``mesh_active`` says THIS trace will wrap the kernel in shard_map (a
     registered-but-unusable mesh, e.g. a non-divisible init trace, must NOT
     count: an unwrapped Mosaic call cannot live in a multi-device program)."""
-    from tpu_rl.ops.pallas_lstm import fits_vmem
+    from tpu_rl.ops.pallas_lstm import batch_tile
 
     if _PALLAS_MODE == "off":
         return False, False
@@ -62,7 +62,8 @@ def _use_pallas(
         # interpreter has no VMEM), so equivalence tests can never silently
         # degrade into scan-vs-scan.
         return True, True
-    if not fits_vmem(batch, seq, hidden):
+    if batch_tile(batch, seq, hidden) is None:
+        # No batch tiling can fit VMEM (very long seq x wide hidden).
         return False, False
     if jax.default_backend() != "tpu":
         return False, False

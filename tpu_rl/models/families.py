@@ -128,8 +128,9 @@ def _act_transformer(
     which empties the caches — no state crosses episodes. Positions are
     episode-relative, matching the training unroll's segment-relative
     positions, so behavior and training policies agree exactly while an
-    episode fits one window (``tests/test_transformer.py`` asserts bit-level
-    agreement with the window path); beyond ``ctx`` the ring-buffer keeps each
+    episode fits one window (``tests/test_transformer.py`` asserts agreement
+    with the window path to float tolerance, and within mixed-precision
+    rounding under bf16); beyond ``ctx`` the ring-buffer keeps each
     token's K/V as originally computed — a policy-lag-like bias absorbed by
     the IS/V-trace corrections."""
     head_d = hidden // n_heads

@@ -122,23 +122,30 @@ class MultiHeadAttention(nn.Module):
         ctx = k_cache.shape[1]
         qkv = self.qkv(x_t).reshape(B, 1, 3, H, C // H)
         q, k_new, v_new = qkv[:, 0, 0], qkv[:, 0, 1], qkv[:, 0, 2]  # (B,H,D)
-        # The worker carry (and thus the caches) is float32; under bf16
-        # compute the projections must be cast back before the slice update.
-        k_new = k_new.astype(k_cache.dtype)
-        v_new = v_new.astype(v_cache.dtype)
-        q = q.astype(k_cache.dtype)
         slot = jnp.mod(count, ctx)
+        # The worker carry (and thus the caches) is float32; bf16 projections
+        # round-trip exactly through the f32 store, so casting back to the
+        # compute dtype below reproduces the training path's inputs bit-for-bit.
         k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k_new[:, None], slot, axis=1
+            k_cache, k_new.astype(k_cache.dtype)[:, None], slot, axis=1
         )
         v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v_new[:, None], slot, axis=1
+            v_cache, v_new.astype(v_cache.dtype)[:, None], slot, axis=1
         )
         valid = jnp.arange(ctx) <= count  # ring not yet wrapped: prefix only
-        scores = jnp.einsum("bhd,bthd->bht", q, k_cache) / np.sqrt(C / H)
+        # Mixed-precision recipe mirrors full_attention/_masked_block_scores:
+        # compute-dtype (possibly bf16) operands into the MXU, float32
+        # accumulation and softmax.
+        kc = k_cache.astype(q.dtype)
+        vc = v_cache.astype(q.dtype)
+        scores = jnp.einsum(
+            "bhd,bthd->bht", q, kc, preferred_element_type=jnp.float32
+        ) * jnp.float32(1.0 / np.sqrt(C / H))
         scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
         w = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bht,bthd->bhd", w, v_cache)
+        o = jnp.einsum(
+            "bht,bthd->bhd", w, vc, preferred_element_type=jnp.float32
+        )
         return self.out(o.reshape(B, 1, C)), k_cache, v_cache
 
 

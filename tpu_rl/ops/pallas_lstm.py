@@ -27,9 +27,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Max VMEM footprint for one batch tile before we refuse (the LSTM families
-# use short windows; long-context training is the transformer's job).
-_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+# Max VMEM footprint for one batch tile before we refuse. ~3/8 of a TPU
+# v5e/v4 core's 128 MB VMEM: fits_vmem counts each buffer once, while Mosaic
+# double-buffers the streamed blocks (xp/hs/cs/acts) across grid steps, so
+# the true high-water mark is < 2x this budget. Wide-hidden workloads (e.g.
+# H=1024: 16 MB of recurrent weights alone) tile their batch via
+# ``batch_tile`` instead of falling back to the scan.
+_VMEM_BUDGET_BYTES = 48 * 1024 * 1024
 
 
 def _make_kernel(save_acts: bool):
@@ -78,22 +82,62 @@ def _make_kernel(save_acts: bool):
 
 def _pallas_forward(xp, wh, h0, c0, keep, interpret: bool, save_acts: bool):
     """xp (B,S,4H), keep (B,S) -> (hs, cs[, acts]) in batch-major layout
-    (the kernel runs time-major internally)."""
+    (the kernel runs time-major internally).
+
+    The batch dimension is tiled over a 1-D Pallas grid: each grid step
+    unrolls the full sequence for one VMEM-sized batch tile while Mosaic
+    streams the next tile's input projection HBM->VMEM behind it. The
+    recurrent weights block is the same for every tile (index_map pins it),
+    so it stays VMEM-resident across the whole grid."""
     B, S, H4 = xp.shape
     H = H4 // 4
+    bt = batch_tile(B, S, H)
+    if bt is None:
+        raise ValueError(
+            f"no VMEM-fitting batch tile for (B={B}, S={S}, H={H}); "
+            "caller should use the scan path"
+        )
+    grid = (B // bt,)
     out_shapes = [
         jax.ShapeDtypeStruct((S, B, H), jnp.float32),  # hs
         jax.ShapeDtypeStruct((S, B, H), jnp.float32),  # cs
     ]
+    out_specs = [
+        pl.BlockSpec((S, bt, H), lambda b: (0, b, 0)),
+        pl.BlockSpec((S, bt, H), lambda b: (0, b, 0)),
+    ]
     if save_acts:
         out_shapes.append(jax.ShapeDtypeStruct((S, B, H4), jnp.float32))
-    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+        out_specs.append(pl.BlockSpec((S, bt, H4), lambda b: (0, b, 0)))
+    in_specs = [
+        pl.BlockSpec((S, bt, H4), lambda b: (0, b, 0)),  # xp
+        pl.BlockSpec((H, H4), lambda b: (0, 0)),  # wh (every tile)
+        pl.BlockSpec((bt, H), lambda b: (b, 0)),  # h0
+        pl.BlockSpec((bt, H), lambda b: (b, 0)),  # c0
+        pl.BlockSpec((S, bt, 1), lambda b: (0, b, 0)),  # keep
+    ]
+    # Raise Mosaic's scoped-VMEM ceiling for this kernel: the default limit
+    # (~16 MB) is below one wide-hidden tile's working set (wh alone is 16 MB
+    # at H=1024). fits_vmem counts each buffer once; with double-buffered
+    # streaming the true high-water is < 2x budget + weights, well under the
+    # 128 MB core VMEM.
+    compiler_params = None
+    if not interpret:
+        cp_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams", None
+        )
+        if cp_cls is not None:
+            compiler_params = cp_cls(
+                vmem_limit_bytes=int(2.2 * _VMEM_BUDGET_BYTES)
+            )
     outs = pl.pallas_call(
         _make_kernel(save_acts),
+        grid=grid,
         out_shape=tuple(out_shapes),
-        in_specs=[vmem] * 5,
-        out_specs=(vmem,) * len(out_shapes),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
         interpret=interpret,
+        compiler_params=compiler_params,
     )(
         jnp.moveaxis(xp, 1, 0),
         wh,
@@ -105,9 +149,31 @@ def _pallas_forward(xp, wh, h0, c0, keep, interpret: bool, save_acts: bool):
 
 
 def fits_vmem(batch: int, seq: int, hidden: int) -> bool:
+    """Does ONE batch tile of this size fit the per-tile VMEM budget?"""
     # xp + acts dominate: 2 * B*S*4H floats, plus hs/cs and weights.
     floats = batch * seq * hidden * (4 + 4 + 1 + 1) + hidden * 4 * hidden
     return floats * 4 <= _VMEM_BUDGET_BYTES
+
+
+def batch_tile(batch: int, seq: int, hidden: int) -> int | None:
+    """Largest batch-tile size — a divisor of ``batch`` — whose VMEM
+    footprint fits the budget. Tiles must be sublane multiples of 8 (or the
+    whole batch, when it both fits and is small): a degenerate few-row tile
+    would serialize the batch over the grid at a fraction of VPU width —
+    strictly worse than the ``lax.scan`` fallback — so shapes with only tiny
+    fitting divisors return None (very long seq x wide hidden: the caller
+    falls back to the scan; long-context training is the transformer's job)."""
+    divs = [
+        d
+        for d in range(1, batch + 1)
+        if batch % d == 0 and fits_vmem(d, seq, hidden)
+    ]
+    if not divs:
+        return None
+    mult8 = [d for d in divs if d % 8 == 0]
+    if mult8:
+        return max(mult8)
+    return batch if batch in divs else None
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
