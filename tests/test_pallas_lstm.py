@@ -158,9 +158,11 @@ def test_batch_tiled_grid_matches_scan(rng, monkeypatch):
         jnp.asarray(rng.normal(size=(B, H)).astype(np.float32)),
     )
     params = cell.init(jax.random.key(0), carry0, x[:, 0])
-    # Budget fits an 8-row tile but not 16 or the whole batch -> grid of 4.
-    monkeypatch.setattr(pk, "_VMEM_BUDGET_BYTES", 40000)
+    # Budget fits an 8-row tile but not 16 or the whole batch -> grid of 4,
+    # for BOTH the forward kernel and the fused backward kernel.
+    monkeypatch.setattr(pk, "_VMEM_BUDGET_BYTES", 48000)
     assert pk.batch_tile(B, S, H) == 8
+    assert pk.bwd_batch_tile(B, S, H) == 8
 
     def loss(params, x, carry0, mode):
         cells.set_pallas_mode(mode)

@@ -36,6 +36,22 @@ from jax.experimental.pallas import tpu as pltpu
 _VMEM_BUDGET_BYTES = 48 * 1024 * 1024
 
 
+def _compiler_params(interpret: bool):
+    """Mosaic params shared by the forward and backward kernels: raise the
+    scoped-VMEM ceiling above the default (~16 MB), which is below one
+    wide-hidden tile's working set (wh alone is 16 MB at H=1024). fits_vmem
+    counts each buffer once; with double-buffered streaming the true
+    high-water is < 2x budget + weights, well under the 128 MB core VMEM."""
+    if interpret:
+        return None
+    cp_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    if cp_cls is None:
+        return None
+    return cp_cls(vmem_limit_bytes=int(2.2 * _VMEM_BUDGET_BYTES))
+
+
 def _make_kernel(save_acts: bool):
     def kernel(xp_ref, wh_ref, h0_ref, c0_ref, keep_ref, hs_ref, cs_ref, *rest):
         """One batch tile, full sequence, TIME-MAJOR layouts (the sequence
@@ -116,20 +132,6 @@ def _pallas_forward(xp, wh, h0, c0, keep, interpret: bool, save_acts: bool):
         pl.BlockSpec((bt, H), lambda b: (b, 0)),  # c0
         pl.BlockSpec((S, bt, 1), lambda b: (0, b, 0)),  # keep
     ]
-    # Raise Mosaic's scoped-VMEM ceiling for this kernel: the default limit
-    # (~16 MB) is below one wide-hidden tile's working set (wh alone is 16 MB
-    # at H=1024). fits_vmem counts each buffer once; with double-buffered
-    # streaming the true high-water is < 2x budget + weights, well under the
-    # 128 MB core VMEM.
-    compiler_params = None
-    if not interpret:
-        cp_cls = getattr(pltpu, "CompilerParams", None) or getattr(
-            pltpu, "TPUCompilerParams", None
-        )
-        if cp_cls is not None:
-            compiler_params = cp_cls(
-                vmem_limit_bytes=int(2.2 * _VMEM_BUDGET_BYTES)
-            )
     outs = pl.pallas_call(
         _make_kernel(save_acts),
         grid=grid,
@@ -137,7 +139,7 @@ def _pallas_forward(xp, wh, h0, c0, keep, interpret: bool, save_acts: bool):
         in_specs=in_specs,
         out_specs=tuple(out_specs),
         interpret=interpret,
-        compiler_params=compiler_params,
+        compiler_params=_compiler_params(interpret),
     )(
         jnp.moveaxis(xp, 1, 0),
         wh,
@@ -155,25 +157,37 @@ def fits_vmem(batch: int, seq: int, hidden: int) -> bool:
     return floats * 4 <= _VMEM_BUDGET_BYTES
 
 
-def batch_tile(batch: int, seq: int, hidden: int) -> int | None:
-    """Largest batch-tile size — a divisor of ``batch`` — whose VMEM
-    footprint fits the budget. Tiles must be sublane multiples of 8 (or the
-    whole batch, when it both fits and is small): a degenerate few-row tile
-    would serialize the batch over the grid at a fraction of VPU width —
-    strictly worse than the ``lax.scan`` fallback — so shapes with only tiny
-    fitting divisors return None (very long seq x wide hidden: the caller
-    falls back to the scan; long-context training is the transformer's job)."""
-    divs = [
-        d
-        for d in range(1, batch + 1)
-        if batch % d == 0 and fits_vmem(d, seq, hidden)
-    ]
+def _best_tile(batch: int, fits) -> int | None:
+    """Largest divisor of ``batch`` accepted by ``fits``, restricted to
+    sublane multiples of 8 (or the whole batch when it both fits and is
+    small): a degenerate few-row tile would serialize the batch over the grid
+    at a fraction of VPU width — strictly worse than the ``lax.scan``
+    fallback — so shapes with only tiny fitting divisors return None."""
+    divs = [d for d in range(1, batch + 1) if batch % d == 0 and fits(d)]
     if not divs:
         return None
     mult8 = [d for d in divs if d % 8 == 0]
     if mult8:
         return max(mult8)
     return batch if batch in divs else None
+
+
+def batch_tile(batch: int, seq: int, hidden: int) -> int | None:
+    """Forward-kernel batch tile, or None when no tiling fits VMEM (very
+    long seq x wide hidden: the caller falls back to the scan; long-context
+    training is the transformer's job)."""
+    return _best_tile(batch, lambda d: fits_vmem(d, seq, hidden))
+
+
+def bwd_batch_tile(batch: int, seq: int, hidden: int) -> int | None:
+    """Backward-kernel batch tile. The backward working set per row is
+    acts + cs + dhs + dcs + dxp ~ 11 H-floats per step, plus the wh block."""
+
+    def fits(d: int) -> bool:
+        floats = d * seq * hidden * 11 + hidden * 4 * hidden
+        return floats * 4 <= _VMEM_BUDGET_BYTES
+
+    return _best_tile(batch, fits)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -193,10 +207,134 @@ def _fwd(xp, wh, h0, c0, keep, interpret):
     return (hs, cs), (xp, wh, h0, c0, keep, hs, cs, acts)
 
 
+def _bwd_kernel(
+    acts_ref, cs_ref, h0_ref, c0_ref, keep_ref, dhs_ref, dcs_ref,
+    wh_ref, dxp_ref, dh0_ref, dc0_ref,
+):
+    """Analytic LSTM backprop for one batch tile, full sequence, reverse
+    time — the fused mirror of the forward kernel: per step, the elementwise
+    gate-gradient math plus ONE (Bt, 4H) x (4H, H) MXU matmul for the carry
+    gradient, with wh VMEM-resident across the grid. The weight gradient is
+    NOT accumulated here: dwh = sum_t h_prev_used[t]^T dz[t] contracts over
+    batch x time, so it is one big MXU matmul over the kernel's dxp output,
+    done outside where the contraction is (B*S)-deep instead of Bt-deep."""
+    S = acts_ref.shape[0]
+    H = wh_ref.shape[0]
+    wh = wh_ref[:]
+
+    def step(idx, carry):
+        dh, dc = carry
+        t = S - 1 - idx
+        act = acts_ref[t]
+        i = act[:, :H]
+        f = act[:, H : 2 * H]
+        g = act[:, 2 * H : 3 * H]
+        o = act[:, 3 * H :]
+        kp = keep_ref[t]  # (Bt, 1)
+        tm1 = jnp.maximum(t - 1, 0)
+        cp = jnp.where(t > 0, cs_ref[tm1], c0_ref[:])
+        cp_used = cp * kp
+        dh_t = dhs_ref[t] + dh
+        t_c2 = jnp.tanh(cs_ref[t])
+        do = dh_t * t_c2
+        dc_t = dcs_ref[t] + dc + dh_t * o * (1.0 - t_c2 * t_c2)
+        di = dc_t * g
+        dg = dc_t * i
+        df = dc_t * cp_used
+        dz = jnp.concatenate(
+            [
+                di * i * (1.0 - i),
+                df * f * (1.0 - f),
+                dg * (1.0 - g * g),
+                do * o * (1.0 - o),
+            ],
+            axis=-1,
+        )  # (Bt, 4H)
+        dxp_ref[t] = dz
+        dh_prev = jnp.dot(dz, wh.T, preferred_element_type=jnp.float32) * kp
+        dc_prev = dc_t * f * kp
+        return dh_prev, dc_prev
+
+    dh, dc = jax.lax.fori_loop(
+        0, S, step, (jnp.zeros_like(h0_ref[:]), jnp.zeros_like(c0_ref[:]))
+    )
+    dh0_ref[:] = dh
+    dc0_ref[:] = dc
+
+
+def _pallas_backward(wh, h0, c0, keep, hs, cs, acts, dhs, dcs, interpret):
+    """Batch-tiled fused backward; same grid scheme as the forward. Returns
+    (dxp, dh0, dc0); the weight gradient is computed by the caller from dxp
+    (one batch*time-deep MXU matmul)."""
+    B, S, H = hs.shape
+    H4 = 4 * H
+    # The interpreter has no VMEM: an untileable shape still runs (whole
+    # batch, grid 1) so tests always exercise the kernel.
+    bt = bwd_batch_tile(B, S, H) or (B if interpret else None)
+    assert bt is not None  # caller gates on bwd_batch_tile
+    grid = (B // bt,)
+    tm = lambda a: jnp.moveaxis(a, 1, 0)
+    seq_spec = lambda w: pl.BlockSpec((S, bt, w), lambda b: (0, b, 0))
+    row_spec = pl.BlockSpec((bt, H), lambda b: (b, 0))
+    wh_spec = pl.BlockSpec((H, H4), lambda b: (0, 0))
+    dxp, dh0, dc0 = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        out_shape=(
+            jax.ShapeDtypeStruct((S, B, H4), jnp.float32),  # dxp (= dz)
+            jax.ShapeDtypeStruct((B, H), jnp.float32),  # dh0
+            jax.ShapeDtypeStruct((B, H), jnp.float32),  # dc0
+        ),
+        in_specs=[
+            seq_spec(H4),  # acts
+            seq_spec(H),  # cs
+            row_spec,  # h0
+            row_spec,  # c0
+            seq_spec(1),  # keep
+            seq_spec(H),  # dhs
+            seq_spec(H),  # dcs
+            wh_spec,  # wh
+        ],
+        out_specs=(seq_spec(H4), row_spec, row_spec),
+        interpret=interpret,
+        compiler_params=_compiler_params(interpret),
+    )(
+        tm(acts), tm(cs), h0, c0, tm(keep)[..., None], tm(dhs),
+        tm(dcs), wh,
+    )
+    return jnp.moveaxis(dxp, 0, 1), dh0, dc0
+
+
 def _bwd(interpret, res, ct):
     xp, wh, h0, c0, keep, hs, cs, acts = res
     dhs, dcs = ct
     B, S, H = hs.shape
+
+    # Fused backward kernel only when the WHOLE batch fits one tile: with a
+    # multi-tile grid each sequential step's carry matmul contracts over just
+    # Bt rows, starving the MXU — measured 0.73x the scan at B1024/H1024 —
+    # while at grid 1 the fusion wins (1.2x at the reference quantum). Wide
+    # multi-tile shapes keep the scan backward, whose per-step matmuls see
+    # the full batch. (lstm_unroll is only reached when the cell chose the
+    # kernel for the forward.)
+    if interpret or (
+        jax.default_backend() == "tpu"
+        and bwd_batch_tile(B, S, H) == B
+    ):
+        dxp, dh0, dc0 = _pallas_backward(
+            wh, h0, c0, keep, hs, cs, acts, dhs, dcs, interpret
+        )
+        # Weight gradient as one (H, B*S) x (B*S, 4H) MXU matmul — the
+        # batch*time-deep contraction the per-tile kernel cannot express
+        # efficiently (a Bt-deep contraction starves the systolic array).
+        h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)
+        dwh = jnp.einsum(
+            "bth,btz->hz",
+            h_prev * keep[..., None],
+            dxp,
+            preferred_element_type=jnp.float32,
+        )
+        return dxp, dwh, dh0, dc0, None
 
     h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)  # (B,S,H)
     c_prev = jnp.concatenate([c0[:, None], cs[:, :-1]], axis=1)
