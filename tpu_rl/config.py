@@ -50,7 +50,8 @@ class Config:
     model: str = "lstm"
     n_heads: int = 4
     n_layers: int = 2
-    # Attention impl for the transformer: "full" | "ring" | "ulysses".
+    # Attention impl for the transformer: "full" | "blockwise" (single-chip
+    # memory-efficient, no (T,T) scores) | "ring" | "ulysses" (seq-sharded).
     attention_impl: str = "full"
     # Worker-side attention context (sliding window) for transformer acting;
     # 0 = use seq_len.
@@ -174,7 +175,7 @@ class Config:
                 "compute_dtype='bfloat16' currently requires "
                 "model='transformer' (LSTM families run float32)"
             )
-        assert self.attention_impl in ("full", "ring", "ulysses")
+        assert self.attention_impl in ("full", "blockwise", "ring", "ulysses")
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         if self.mesh_seq > 1:
             assert self.model == "transformer", (

@@ -92,7 +92,7 @@ class MultiHeadAttention(nn.Module):
             B % self.mesh.shape[DATA_AXIS] == 0
             and T % self.mesh.shape[SEQ_AXIS] == 0
         )
-        if tiles_mesh and self.attention_impl != "full":
+        if tiles_mesh and self.attention_impl in ("ring", "ulysses"):
             qs = P(DATA_AXIS, SEQ_AXIS, None, None)
             ps = P(DATA_AXIS, SEQ_AXIS)
             attn = jax.shard_map(
@@ -102,6 +102,10 @@ class MultiHeadAttention(nn.Module):
                 out_specs=qs,
             )
             o = attn(q, k, v, pos, seg)
+        elif self.attention_impl == "blockwise":
+            # Single-device memory-efficient path: O(block^2) transients
+            # instead of the (T, T) score matrix.
+            o = impl(q, k, v, pos, seg, causal=True)
         else:
             o = full_attention(q, k, v, pos, seg, causal=True)
         return self.out(o.reshape(B, T, C))

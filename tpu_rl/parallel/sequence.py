@@ -306,8 +306,198 @@ def full_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+# ------------------------------------------------------- blockwise (1 chip)
+# Default tile: (B, H, 512, 512) f32 score transients stay in the few-MB
+# range for typical model widths while each matmul is still MXU-sized.
+BLOCKWISE_BLOCK = 512
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    seg: jax.Array,
+    axis_name: str | None = None,
+    causal: bool = True,
+    block: int = BLOCKWISE_BLOCK,
+) -> jax.Array:
+    """Exact single-device attention that never materializes the (T, T)
+    score matrix — the memory-efficient / flash-attention scheme, as a
+    ``lax.scan`` over (Q-block, K-block) tiles with the same online-softmax
+    accumulator the ring uses. Memory is O(T·D + block²) instead of O(T²),
+    which is what caps ``full_attention``'s long-context batch size (at
+    T=2048, B=32, H=8 the materialized scores alone are 4 GB).
+
+    Same contract as :func:`full_attention` (full arrays, no sharding); the
+    custom VJP recomputes block scores from the saved per-row logsumexp, so
+    backward residuals are O(T) (q, k, v, out, lse), matching the ring.
+    ``T % block`` need not be 0: the sequence is padded up to a whole number
+    of near-``block`` tiles with segment-id -1 rows (matching no real
+    segment, so they are fully masked out), and the padding is sliced off the
+    output — padding/slicing sit OUTSIDE the custom VJP, so autodiff handles
+    their cotangents exactly."""
+    T = q.shape[1]
+    nb = max(1, -(-T // block))  # ceil
+    blk = -(-T // nb)  # ceil: nb tiles of blk >= T rows
+    pad = nb * blk - T
+    if pad:
+        pad3 = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q_p = jnp.pad(q, pad3)
+        k_p = jnp.pad(k, pad3)
+        v_p = jnp.pad(v, pad3)
+        pos_p = jnp.pad(q_pos, ((0, 0), (0, pad)))
+        seg_p = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
+        out = _blockwise_vjp(bool(causal), int(blk), q_p, k_p, v_p, pos_p, seg_p)
+        return out[:, :T]
+    return _blockwise_vjp(bool(causal), int(blk), q, k, v, q_pos, seg)
+
+
+def _split_blocks(x, nb):
+    """(B, T, ...) -> (nb, B, T/nb, ...) scan-major blocks."""
+    B, T = x.shape[0], x.shape[1]
+    return jnp.moveaxis(x.reshape(B, nb, T // nb, *x.shape[2:]), 1, 0)
+
+
+def _merge_blocks(xb):
+    """(nb, B, blk, ...) -> (B, nb*blk, ...)."""
+    nb, B, blk = xb.shape[0], xb.shape[1], xb.shape[2]
+    return jnp.moveaxis(xb, 0, 1).reshape(B, nb * blk, *xb.shape[3:])
+
+
+def _blockwise_forward(causal, block, q, k, v, q_pos, seg):
+    B, T, H, D = q.shape
+    nb = T // block
+    scale = 1.0 / np.sqrt(D)
+    kb = (_split_blocks(k, nb), _split_blocks(v, nb),
+          _split_blocks(q_pos, nb), _split_blocks(seg, nb))
+
+    def q_body(_, xs):
+        q_blk, qpos, qseg = xs
+
+        def k_body(carry, ks):
+            k_blk, v_blk, kpos, kseg = ks
+            scores = _masked_block_scores(
+                q_blk, k_blk, qpos, kpos, qseg, kseg, scale, causal
+            )
+            return _online_update(*carry, scores, v_blk), None
+
+        o = jnp.zeros((B, block, H, D), jnp.float32)
+        m = jnp.full((B, H, block), _NEG_INF, jnp.float32)
+        l = jnp.zeros((B, H, block), jnp.float32)
+        (o, m, l), _ = jax.lax.scan(k_body, (o, m, l), kb)
+        l = jnp.maximum(l, 1e-30)
+        lse = m + jnp.log(l)  # (B, H, blk)
+        out_blk = (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+        return None, (out_blk, lse)
+
+    _, (out_b, lse_b) = jax.lax.scan(
+        q_body, None,
+        (_split_blocks(q, nb), _split_blocks(q_pos, nb), _split_blocks(seg, nb)),
+    )
+    return _merge_blocks(out_b), lse_b  # out (B,T,H,D); lse (nb,B,H,blk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _blockwise_vjp(causal, block, q, k, v, q_pos, seg):
+    out, _ = _blockwise_forward(causal, block, q, k, v, q_pos, seg)
+    return out
+
+
+def _blockwise_vjp_fwd(causal, block, q, k, v, q_pos, seg):
+    out, lse_b = _blockwise_forward(causal, block, q, k, v, q_pos, seg)
+    return out, (q, k, v, q_pos, seg, out, lse_b)
+
+
+def _blockwise_vjp_bwd(causal, block, res, do):
+    """Flash-attention backward over local tiles: outer scan over Q blocks
+    carries full dK/dV accumulators (updated per K block by dynamic slice),
+    emitting dQ blocks; probabilities are recomputed from the saved
+    logsumexp, exactly as the ring backward does across devices."""
+    q, k, v, q_pos, seg, out, lse_b = res
+    B, T, H, D = q.shape
+    nb = T // block
+    scale = 1.0 / np.sqrt(D)
+    do32 = do.astype(jnp.float32)
+    delta = (do32 * out.astype(jnp.float32)).sum(axis=-1)  # (B, T, H)
+    kb = (
+        _split_blocks(k, nb), _split_blocks(v, nb),
+        _split_blocks(q_pos, nb), _split_blocks(seg, nb),
+        jnp.arange(nb),
+    )
+
+    def q_body(carry, xs):
+        dk, dv = carry
+        q_blk, qpos, qseg, do_blk, lse, delta_blk = xs
+        q32 = q_blk.astype(jnp.float32)
+
+        def k_body(inner, ks):
+            dq_blk, dk, dv = inner
+            k_blk, v_blk, kpos, kseg, kidx = ks
+            scores = _masked_block_scores(
+                q_blk, k_blk, qpos, kpos, qseg, kseg, scale, causal
+            )
+            p = jnp.where(
+                scores <= _NEG_INF * 0.5, 0.0, jnp.exp(scores - lse[..., None])
+            )
+            dv_c = jnp.einsum(
+                "bhqk,bqhd->bkhd", p, do_blk, preferred_element_type=jnp.float32
+            )
+            dp = jnp.einsum(
+                "bqhd,bkhd->bhqk", do_blk, v_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_blk[..., None]) * jnp.float32(scale)
+            dq_blk = dq_blk + jnp.einsum(
+                "bhqk,bkhd->bqhd", ds, k_blk.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            dk_c = jnp.einsum(
+                "bhqk,bqhd->bkhd", ds, q32, preferred_element_type=jnp.float32
+            )
+            start = kidx * block
+            dk = jax.lax.dynamic_update_slice_in_dim(
+                dk, jax.lax.dynamic_slice_in_dim(dk, start, block, 1) + dk_c,
+                start, axis=1,
+            )
+            dv = jax.lax.dynamic_update_slice_in_dim(
+                dv, jax.lax.dynamic_slice_in_dim(dv, start, block, 1) + dv_c,
+                start, axis=1,
+            )
+            return (dq_blk, dk, dv), None
+
+        dq_blk = jnp.zeros((B, block, H, D), jnp.float32)
+        (dq_blk, dk, dv), _ = jax.lax.scan(k_body, (dq_blk, dk, dv), kb)
+        return (dk, dv), dq_blk
+
+    do_b = _split_blocks(do32, nb)
+    (dk, dv), dq_b = jax.lax.scan(
+        q_body,
+        (jnp.zeros_like(k, dtype=jnp.float32), jnp.zeros_like(v, dtype=jnp.float32)),
+        (
+            _split_blocks(q, nb), _split_blocks(q_pos, nb),
+            _split_blocks(seg, nb), do_b, lse_b,
+            # (nb, B, blk, H) -> (nb, B, H, blk) to match ds's row axis
+            _split_blocks(delta, nb).transpose(0, 1, 3, 2),
+        ),
+    )
+    zero_pos = np.zeros(q_pos.shape, dtype=jax.dtypes.float0)
+    zero_seg = np.zeros(seg.shape, dtype=jax.dtypes.float0)
+    return (
+        _merge_blocks(dq_b).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        zero_pos,
+        zero_seg,
+    )
+
+
+_blockwise_vjp.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
+
+
 ATTENTION_IMPLS = {
     "full": full_attention,
+    "blockwise": blockwise_attention,
     "ring": ring_attention,
     "ulysses": ulysses_attention,
 }
