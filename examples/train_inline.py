@@ -75,9 +75,25 @@ def run(
     # compile at the boundary; the optimizer state carries over (the
     # on-policy families use rmsprop, whose accumulator is lr-independent).
     anneal = overrides.pop("entropy_anneal", None)
+    # Random-action warmup (off-policy exploration aid): for the first N env
+    # steps act uniformly at random instead of from the policy. Standard SAC
+    # practice for sparse-goal envs like MountainCarContinuous, where the
+    # tanh-Gaussian's zero-mean noise averages to no net force and the car
+    # never leaves the valley; uniform bang-bang actions occasionally complete
+    # the resonant swing, seeding the replay buffer with goal rewards. SAC
+    # recomputes log-probs from the current policy (off-policy), so behavior
+    # actions need no importance correction.
+    warmup_steps = int(overrides.pop("warmup_steps", 0))
     cfg_dict.update(overrides)
     cfg = probe_spaces(Config.from_dict(cfg_dict))
     off_policy = is_off_policy(cfg.algo)
+    if warmup_steps and not off_policy:
+        # On-policy algos (PPO/IMPALA/V-MPO) compute importance ratios from
+        # the stored behavior log-probs; warmup actions are NOT drawn from the
+        # policy, so those ratios would silently be garbage.
+        raise ValueError(
+            "warmup_steps requires an off-policy algorithm (SAC/SAC-Continuous)"
+        )
     spec = get_algo(cfg.algo)
     family, state, train_step = spec.build(cfg, jax.random.key(seed))
     train_step = jax.jit(train_step)
@@ -117,6 +133,18 @@ def run(
             key, sub = jax.random.split(key)
             ob = jnp.asarray(obs, jnp.float32)[None]
             a, logits, log_prob, h2, c2 = act(act_params(state), ob, h, c, sub)
+            if env_steps < warmup_steps:
+                # keep the policy carry (h2, c2) consistent with what the
+                # policy *saw*, but override the executed/stored action.
+                if family.continuous:
+                    a = jnp.asarray(
+                        rng.uniform(-1.0, 1.0, size=a.shape), jnp.float32
+                    )
+                else:
+                    a = jnp.asarray(
+                        rng.integers(0, cfg.action_space, size=a.shape),
+                        a.dtype,
+                    )
             next_obs, rew, done = env.step(np.asarray(a[0]))
             epi_rew += rew
             epi_steps += 1
@@ -198,7 +226,10 @@ def run(
     # deployment. The LSTM/transformer carry depends only on observations,
     # so the same jitted act drives both.
     eval_mean = None
-    if not family.continuous:
+    greedy_act = (
+        jax.jit(family.act_greedy) if family.act_greedy is not None else None
+    )
+    if not family.continuous or greedy_act is not None:
         returns = []
         for ep in range(20):
             obs = env.reset()
@@ -206,11 +237,18 @@ def run(
             c = jnp.zeros((1, cw))
             total, steps, done = 0.0, 0, False
             while not done and steps < cfg.time_horizon:
-                _a, logits, _lp, h, c = act(
-                    act_params(state), jnp.asarray(obs, jnp.float32)[None],
-                    h, c, jax.random.key(ep * 1000 + steps),
-                )
-                greedy = np.asarray([float(np.argmax(np.asarray(logits[0])))])
+                ob = jnp.asarray(obs, jnp.float32)[None]
+                if family.continuous:
+                    a, h, c = greedy_act(act_params(state), ob, h, c)
+                    greedy = np.asarray(a[0])
+                else:
+                    _a, logits, _lp, h, c = act(
+                        act_params(state), ob, h, c,
+                        jax.random.key(ep * 1000 + steps),
+                    )
+                    greedy = np.asarray(
+                        [float(np.argmax(np.asarray(logits[0])))]
+                    )
                 obs, rew, done = env.step(greedy)
                 total += rew
                 steps += 1

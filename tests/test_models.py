@@ -130,3 +130,22 @@ def test_sac_continuous_critic_shapes():
     mu, log_std = fam.actor_unroll(params["actor"], obs, carry0, firsts)
     assert mu.shape == (B, S, 1)
     assert float(jnp.max(log_std)) <= 2.0 and float(jnp.min(log_std)) >= -20.0
+
+
+@pytest.mark.parametrize("algo", ["PPO-Continuous", "SAC-Continuous"])
+def test_continuous_greedy_act(algo):
+    """``act_greedy`` returns the deterministic (tanh-squashed) mean action:
+    bounded to (-1, 1), identical across calls, same carry contract as
+    ``act``."""
+    cfg = small_config(algo=algo, is_continuous=True)
+    fam = build_family(cfg)
+    params = fam.init_params(jax.random.PRNGKey(0), seq_len=cfg.seq_len)
+
+    obs = jnp.ones((fam.obs_dim,))
+    h = jnp.zeros((fam.hidden,))
+    a1, h2, c2 = fam.act_greedy(params, obs, h, h)
+    a2, _, _ = fam.act_greedy(params, obs, h, h)
+    assert a1.shape == (fam.n_actions,)
+    assert h2.shape == (fam.hidden,) and c2.shape == (fam.hidden,)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert np.all(np.abs(np.asarray(a1)) <= 1.0)

@@ -54,6 +54,13 @@ class ModelFamily:
     act: Callable[..., tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]] = (
         field(repr=False, default=None)
     )
+    # Deterministic acting for evaluation: ``act_greedy(params, obs, h, c)
+    # -> (action, h', c')``. Continuous families return the distribution mean
+    # (already tanh-squashed); discrete evaluation argmaxes the logits that
+    # ``act`` returns, so only continuous families set this.
+    act_greedy: Callable[..., tuple[jax.Array, jax.Array, jax.Array]] | None = field(
+        repr=False, default=None
+    )
     # Widths of the worker-side acting carry (h, c). LSTM: (hidden, hidden).
     # Transformer: (obs-history window, step counter).
     act_carry_widths: tuple[int, int] | None = None
@@ -105,6 +112,16 @@ def _act_continuous_ac(actor: ContinuousActorCritic, params, obs, h, c, key):
     a = D.normal_sample(key, mu, std)
     log_prob = D.normal_log_prob(mu, std, a)
     return a, jnp.zeros_like(mu), log_prob, h2, c2
+
+
+def _greedy_continuous_ac(actor: ContinuousActorCritic, params, obs, h, c):
+    mu, _std, _v, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
+    return mu, h2, c2
+
+
+def _greedy_sac_continuous(actor, params, obs, h, c):
+    mu, _log_std, (h2, c2) = actor.apply(params["actor"], obs, (h, c), method="act")
+    return jnp.tanh(mu), h2, c2
 
 
 def _act_sac_discrete(actor: SACDiscreteActor, params, obs, h, c, key):
@@ -233,6 +250,7 @@ def build_family(cfg: Config, mesh=None) -> ModelFamily:
         fam = ModelFamily(
             cfg.algo, True, False, actor, None, obs_dim, n, cfg.hidden_size,
             act=partial(_act_continuous_ac, actor),
+            act_greedy=partial(_greedy_continuous_ac, actor),
         )
     elif cfg.algo == "SAC":
         actor = SACDiscreteActor(n_actions=n, **kw)
@@ -247,6 +265,7 @@ def build_family(cfg: Config, mesh=None) -> ModelFamily:
         fam = ModelFamily(
             cfg.algo, True, True, actor, critic, obs_dim, n, cfg.hidden_size,
             act=partial(_act_sac_continuous, actor),
+            act_greedy=partial(_greedy_sac_continuous, actor),
         )
     else:
         raise ValueError(f"unknown algo {cfg.algo!r}")
