@@ -129,8 +129,17 @@ class Config:
     # process owns the chip).
     learner_device: str = "auto"
     # Worker step throttle, seconds (reference hard-codes 0.05:
-    # /root/reference/agents/worker.py:131). 0 disables.
+    # /root/reference/agents/worker.py:131). 0 disables. With
+    # worker_num_envs > 1 the throttle applies per batched tick.
     worker_step_sleep: float = 0.05
+    # Number of gymnasium envs one worker process steps with a SINGLE batched
+    # act() call per tick (TPU-native vectorized acting; the reference is
+    # strictly one env per process, /root/reference/agents/worker.py:87-142,
+    # capping each process at ~20 env-steps/s). Batching the policy forward
+    # amortizes dispatch overhead, so one process sustains ~N x the reference
+    # per-process throughput. LSTM backbone only (the transformer acting
+    # carry packs a per-env KV cache + step counter that assumes batch 1).
+    worker_num_envs: int = 1
     # RolloutAssembler idle-trajectory drop window, seconds
     # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
     rollout_lag_sec: float = 0.5
@@ -177,6 +186,12 @@ class Config:
             )
         assert self.attention_impl in ("full", "blockwise", "ring", "ulysses")
         assert self.learner_device in ("auto", "cpu"), self.learner_device
+        assert self.worker_num_envs >= 1, self.worker_num_envs
+        if self.worker_num_envs > 1:
+            assert self.model == "lstm", (
+                "worker_num_envs>1 requires model='lstm' (the transformer "
+                "acting carry packs a per-env KV cache that assumes batch 1)"
+            )
         if self.mesh_seq > 1:
             assert self.model == "transformer", (
                 "sequence parallelism (mesh_seq>1) requires model='transformer'"

@@ -71,7 +71,15 @@ class Worker:
             params = family.init_params(init_key, seq_len=cfg.seq_len)
         act = jax.jit(family.act)
 
-        env = EnvAdapter(cfg, seed=self.seed * 131 + self.worker_id)
+        # Vectorized acting: N envs stepped per tick with ONE batched policy
+        # forward (worker_num_envs; N=1 reproduces the reference's
+        # one-env-per-process loop exactly). Each env keeps its own episode
+        # identity, carry row, and stats; resets zero only that env's carry.
+        n = cfg.worker_num_envs
+        envs = [
+            EnvAdapter(cfg, seed=self.seed * 131 + self.worker_id + i * 7919)
+            for i in range(n)
+        ]
         # Acting carry shapes come from the family (LSTM: hidden states;
         # transformer: obs-history window + counter); batch storage widths
         # come from the layout and may be placeholders when the carry is
@@ -80,13 +88,15 @@ class Worker:
 
         lay = BatchLayout.from_config(cfg)
         hw, cw = family.carry_widths
-        h = jnp.zeros((1, hw))
-        c = jnp.zeros((1, cw))
+        h = jnp.zeros((n, hw))
+        c = jnp.zeros((n, cw))
         hx_stub = np.zeros((lay.hx,), np.float32)
         cx_stub = np.zeros((lay.cx,), np.float32)
-        obs = env.reset()
-        episode_id = uuid.uuid4().hex
-        is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
+        obs = np.stack([e.reset() for e in envs]).astype(np.float32)
+        episode_ids = [uuid.uuid4().hex for _ in range(n)]
+        is_fir = np.ones(n, np.float32)
+        epi_rew = np.zeros(n, np.float64)
+        epi_steps = np.zeros(n, np.int64)
         n_model_loads = 0
 
         try:
@@ -99,43 +109,61 @@ class Worker:
                         n_model_loads += 1
 
                 key, sub_key = jax.random.split(key)
-                ob = jnp.asarray(obs, jnp.float32)[None]
-                a, logits, log_prob, h2, c2 = act(params, ob, h, c, sub_key)
-                next_obs, rew, done = env.step(np.asarray(a[0]))
-                epi_rew += rew
-                epi_steps += 1
-                horizon_hit = epi_steps >= cfg.time_horizon
-                step_msg = dict(
-                    obs=np.asarray(ob[0]),
-                    act=np.asarray(a[0]),
-                    rew=np.asarray([rew * cfg.reward_scale], np.float32),
-                    logits=np.asarray(logits[0]),
-                    log_prob=np.asarray(log_prob[0]),
-                    is_fir=np.asarray([is_fir], np.float32),
-                    hx=np.asarray(h[0]) if family.store_carry else hx_stub,
-                    cx=np.asarray(c[0]) if family.store_carry else cx_stub,
-                    id=episode_id,
-                    done=bool(done or horizon_hit),
+                a, logits, log_prob, h2, c2 = act(
+                    params, jnp.asarray(obs), h, c, sub_key
                 )
-                pub.send(Protocol.Rollout, step_msg)
+                a_np = np.asarray(a)
+                logits_np = np.asarray(logits)
+                lp_np = np.asarray(log_prob)
+                h_np = np.asarray(h) if family.store_carry else None
+                c_np = np.asarray(c) if family.store_carry else None
 
-                is_fir = 0.0
-                obs, h, c = next_obs, h2, c2
-                if done or horizon_hit:
-                    pub.send(Protocol.Stat, float(epi_rew))
-                    obs = env.reset()
-                    h = jnp.zeros_like(h)
-                    c = jnp.zeros_like(c)
-                    episode_id = uuid.uuid4().hex
-                    is_fir, epi_rew, epi_steps = 1.0, 0.0, 0
+                reset_rows = np.zeros(n, np.float32)
+                for i, env in enumerate(envs):
+                    next_ob, rew, done = env.step(a_np[i])
+                    epi_rew[i] += rew
+                    epi_steps[i] += 1
+                    horizon_hit = epi_steps[i] >= cfg.time_horizon
+                    step_msg = dict(
+                        obs=obs[i].copy(),
+                        act=a_np[i],
+                        rew=np.asarray([rew * cfg.reward_scale], np.float32),
+                        logits=logits_np[i],
+                        log_prob=lp_np[i],
+                        is_fir=np.asarray([is_fir[i]], np.float32),
+                        hx=h_np[i] if family.store_carry else hx_stub,
+                        cx=c_np[i] if family.store_carry else cx_stub,
+                        id=episode_ids[i],
+                        done=bool(done or horizon_hit),
+                    )
+                    pub.send(Protocol.Rollout, step_msg)
+
+                    is_fir[i] = 0.0
+                    obs[i] = next_ob
+                    if done or horizon_hit:
+                        pub.send(Protocol.Stat, float(epi_rew[i]))
+                        obs[i] = env.reset()
+                        reset_rows[i] = 1.0
+                        episode_ids[i] = uuid.uuid4().hex
+                        is_fir[i], epi_rew[i], epi_steps[i] = 1.0, 0.0, 0
+
+                # Carry forward; zero only the rows whose episode ended.
+                if reset_rows.any():
+                    keep = jnp.asarray(1.0 - reset_rows)[:, None]
+                    h, c = h2 * keep, c2 * keep
+                else:
+                    h, c = h2, c2
 
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
                 if cfg.worker_step_sleep > 0:
                     # Reference throttle (``worker.py:131``); 0 disables.
+                    # Applies per tick (= per batched act), so N envs yield
+                    # N env-steps per throttle window.
                     time.sleep(cfg.worker_step_sleep)
         finally:
-            env.close()
+            for env in envs:
+                env.close()
             pub.close()
             model_sub.close()
 
