@@ -1,0 +1,110 @@
+"""Actor-side throughput: env-steps/s of ONE worker process as a function of
+``worker_num_envs`` (vectorized acting), against the reference's
+by-construction per-process ceiling.
+
+The reference worker steps one env per process with a per-step forward and a
+hard 0.05 s sleep (``/root/reference/agents/worker.py:131``) — ~20 env-steps/s
+per process, ~600/s for the configured 30-process fleet (BASELINE.md). Here
+one process steps N envs with a single batched jitted forward per tick; this
+script measures the real end-to-end loop (gymnasium stepping + batched act +
+ZMQ publish into a draining SUB) with the throttle off.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/bench_worker_throughput.py \
+      [--envs 1 8 32] [--seconds 20] [--out bench_worker.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(num_envs: int, seconds: float, base_port: int) -> dict:
+    from tests.conftest import small_config  # reuse the tiny-config helper
+    from tpu_rl.runtime.protocol import Protocol
+    from tpu_rl.runtime.transport import Pub, Sub
+    from tpu_rl.runtime.worker import Worker
+
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        hidden_size=64,  # reference model size
+        worker_step_sleep=0.0,
+        worker_num_envs=num_envs,
+        time_horizon=500,
+    )
+    relay = Sub("127.0.0.1", base_port, bind=True)
+    model_pub = Pub("127.0.0.1", base_port + 1, bind=True)
+    stop = threading.Event()
+    w = Worker(
+        cfg, worker_id=0, manager_ip="127.0.0.1", manager_port=base_port,
+        learner_ip="127.0.0.1", model_port=base_port + 1, stop_event=stop,
+    )
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+
+    n_msgs = 0
+    # warmup (jit compile + zmq join), then timed window
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        if relay.recv(timeout_ms=100) is not None:
+            n_msgs += 1
+    n_msgs = 0
+    t0 = time.time()
+    deadline = t0 + seconds
+    while time.time() < deadline:
+        got = relay.recv(timeout_ms=100)
+        if got is not None and got[0] == Protocol.Rollout:
+            n_msgs += 1
+    elapsed = time.time() - t0
+    stop.set()
+    t.join(timeout=30)
+    relay.close()
+    model_pub.close()
+    sps = n_msgs / elapsed
+    return dict(
+        num_envs=num_envs,
+        env_steps_per_s=round(sps, 1),
+        per_env_steps_per_s=round(sps / num_envs, 1),
+        seconds=round(elapsed, 1),
+        vs_reference_per_process=round(sps / 20.0, 1),
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--envs", type=int, nargs="+", default=[1, 8, 32])
+    p.add_argument("--seconds", type=float, default=20.0)
+    p.add_argument("--out", default="bench_worker.json")
+    args = p.parse_args()
+
+    rows = []
+    for i, n in enumerate(args.envs):
+        row = measure(n, args.seconds, 29800 + 4 * i)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(
+            dict(
+                note=(
+                    "one worker process, CartPole-v1, hidden 64, throttle off; "
+                    "reference per-process ceiling is ~20 env-steps/s "
+                    "(0.05 s sleep, /root/reference/agents/worker.py:131)"
+                ),
+                rows=rows,
+            ),
+            f,
+            indent=1,
+        )
+    print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -128,6 +128,32 @@ def test_vmpo_temperatures_update():
     assert np.isfinite(float(m["eta"]))
 
 
+def test_vmpo_stays_finite_under_extreme_ratios():
+    """NaN regression: the reference's temperature dual computes
+    ``ratio.exp().mean().log()`` (``v_mpo/learning.py:84``), which overflows
+    to inf once advantage/eta exceeds ~88 — observed as loss=+nan in long
+    K_epoch=4 CartPole runs after eta annealed low. The logsumexp form plus
+    the projected eta floor must keep every loss and parameter finite even
+    with 1000x-scaled rewards and a collapsed temperature."""
+    cfg = small_config(algo="V-MPO", K_epoch=4)
+    spec = get_algo("V-MPO")
+    fam, state, train_step = spec.build(cfg, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, fam)
+    batch = batch.replace(rew=batch.rew * 1000.0)  # ratios >> 88
+    state = state.replace(
+        params={**state.params, "log_eta": jnp.asarray(np.log(1e-6), jnp.float32)}
+    )
+    step = jax.jit(train_step)
+    for i in range(3):
+        state, m = step(state, batch, jax.random.PRNGKey(10 + i))
+    for k, v in m.items():
+        assert np.isfinite(float(v)), (k, v)
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # the floor holds
+    assert float(state.params["log_eta"]) >= np.log(1e-6) - 1e-6
+
+
 def test_sac_alpha_autotunes():
     cfg = small_config(algo="SAC")
     spec = get_algo("SAC")

@@ -66,8 +66,14 @@ def make_train_step(cfg: Config, family: ModelFamily):
 
         loss_value = smooth_l1(value[:, :-1], td_target)
 
-        loss_temperature = eta * cfg.coef_eta + eta * jnp.log(
-            jnp.mean(jnp.exp(ratio))
+        # Temperature dual. The reference computes ``ratio.exp().mean().log()``
+        # (``v_mpo/learning.py:84``), which overflows to inf -> NaN once any
+        # ratio exceeds ~88 (observed in long K_epoch>1 runs when eta anneals
+        # low while advantages spike). logsumexp(r) - log(N) is the same
+        # quantity in exact arithmetic, stable for any ratio magnitude —
+        # documented divergence, numerics only.
+        loss_temperature = eta * cfg.coef_eta + eta * (
+            jax.nn.logsumexp(ratio) - jnp.log(float(ratio.size))
         )
 
         # per-update KL bound, log-uniform in [coef_alpha_below, coef_alpha_upper]
@@ -110,6 +116,13 @@ def make_train_step(cfg: Config, family: ModelFamily):
             )
             updates, opt_state = opt.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            # Projected floor on the temperature: eta -> 0 makes the psi
+            # weights one-hot and the advantage ratios arbitrarily large.
+            # Projection after the step (not clipping inside the loss, which
+            # would zero the dual's gradient and freeze it below the floor).
+            params["log_eta"] = jnp.maximum(
+                params["log_eta"], jnp.log(1e-6)
+            )
             state = state.replace(params=params, opt_state=opt_state)
             metrics["grad-norm"] = gnorm
         return state.replace(step=state.step + 1), metrics
