@@ -27,18 +27,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def measure(num_envs: int, seconds: float, base_port: int) -> dict:
-    from tests.conftest import small_config  # reuse the tiny-config helper
+    from tpu_rl.config import Config
     from tpu_rl.runtime.protocol import Protocol
     from tpu_rl.runtime.transport import Pub, Sub
     from tpu_rl.runtime.worker import Worker
 
-    cfg = small_config(
-        env="CartPole-v1",
-        algo="PPO",
-        hidden_size=64,  # reference model size
-        worker_step_sleep=0.0,
-        worker_num_envs=num_envs,
-        time_horizon=500,
+    cfg = Config.from_dict(
+        dict(
+            env="CartPole-v1",
+            algo="PPO",
+            hidden_size=64,  # reference model size
+            obs_shape=(4,),
+            action_space=2,
+            worker_step_sleep=0.0,
+            worker_num_envs=num_envs,
+            time_horizon=500,
+        )
     )
     relay = Sub("127.0.0.1", base_port, bind=True)
     model_pub = Pub("127.0.0.1", base_port + 1, bind=True)

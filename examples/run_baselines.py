@@ -71,18 +71,25 @@ CONFIGS: dict[str, dict] = {
     "PPO-Continuous": dict(
         algo="PPO-Continuous", env_name="MountainCarContinuous-v0",
         target=90.0,
-        # Sparse-goal exploration env: a strong entropy bonus keeps the
-        # Gaussian std wide enough to discover the resonant swing (vanilla
-        # PPO with near-zero entropy reliably collapses to the do-nothing
-        # local optimum here), gamma close to 1 carries the +100 terminal
-        # reward back through ~999-step episodes, and the anneal sharpens
-        # the policy once the goal is being reached.
+        # Sparse-goal exploration env. An entropy bonus alone is not enough:
+        # measured, entropy_coef=0.05 still collapsed into the do-nothing
+        # local optimum (mean-50 -7.5, greedy -1.0 after 6k updates) — the
+        # -0.1*a^2 action penalty pays the policy to shrink its std before
+        # the goal is ever found. std_floor keeps the sampling distribution
+        # wide (exactly on-policy: acting and training share the floored
+        # std), gamma ~1 carries the +100 terminal reward through ~999-step
+        # episodes, and the anneal drops the floor + entropy once the goal
+        # is being exploited so the sampled mean-50 can clear 90.
         overrides=dict(
-            entropy_coef=0.05,
-            gamma=0.999,
+            std_floor=0.35,
+            entropy_coef=0.005,
+            gamma=0.9999,
+            batch_size=64,
             time_horizon=999,
             reward_scale=0.1,
-            entropy_anneal={"coef": 1e-3, "lr": 1.5e-4, "frac": 0.6},
+            entropy_anneal={
+                "coef": 1e-4, "lr": 1.5e-4, "std_floor": 0.05, "frac": 0.5,
+            },
         ),
     ),
     "SAC-Continuous": dict(
@@ -90,10 +97,13 @@ CONFIGS: dict[str, dict] = {
         target=90.0,
         # Sparse-goal exploration: the tanh-Gaussian's zero-mean noise
         # averages to no net force, so a pure-policy SAC never escapes the
-        # valley (measured: mean-50 stuck near -33 after 10k updates).
-        # Uniform random warmup actions occasionally complete the resonant
-        # swing and seed the replay with goal (+100) rewards; gamma ~1
-        # carries that signal back through the ~999-step episodes.
+        # valley (measured: mean-50 stuck near -33 after 10k updates), and
+        # iid-uniform warmup is no better (measured 0/20 random episodes
+        # reach the goal; recorded run ended at greedy -0.38). STICKY
+        # bang-bang warmup (train_inline) pumps the resonant swing — 20/20
+        # scripted episodes reach the goal — so the replay actually contains
+        # goal (+100) rewards; gamma ~1 carries that signal back through the
+        # ~999-step episodes.
         overrides=dict(
             time_horizon=999, reward_scale=0.1, lr=3e-4, buffer_size=8192,
             gamma=0.999, warmup_steps=10_000,

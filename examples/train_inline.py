@@ -76,14 +76,17 @@ def run(
     # on-policy families use rmsprop, whose accumulator is lr-independent).
     anneal = overrides.pop("entropy_anneal", None)
     # Random-action warmup (off-policy exploration aid): for the first N env
-    # steps act uniformly at random instead of from the policy. Standard SAC
-    # practice for sparse-goal envs like MountainCarContinuous, where the
-    # tanh-Gaussian's zero-mean noise averages to no net force and the car
-    # never leaves the valley; uniform bang-bang actions occasionally complete
-    # the resonant swing, seeding the replay buffer with goal rewards. SAC
-    # recomputes log-probs from the current policy (off-policy), so behavior
-    # actions need no importance correction.
+    # steps act from a scripted random policy instead of the learned one.
+    # Continuous envs use STICKY bang-bang actions (a held +/-1 that flips
+    # sign with small probability, plus jitter): on MountainCarContinuous,
+    # iid uniform actions average to no net force and measured 0/20 episodes
+    # ever reach the goal, while sticky bang-bang pumps the resonant swing
+    # and reaches it 20/20 — the replay buffer actually gets goal rewards.
+    # Discrete envs keep iid uniform. SAC recomputes log-probs from the
+    # current policy (off-policy), so behavior actions need no importance
+    # correction.
     warmup_steps = int(overrides.pop("warmup_steps", 0))
+    warmup_flip_p = float(overrides.pop("warmup_flip_p", 0.1))
     cfg_dict.update(overrides)
     cfg = probe_spaces(Config.from_dict(cfg_dict))
     off_policy = is_off_policy(cfg.algo)
@@ -109,8 +112,10 @@ def run(
     is_fir = 1.0
     epi_rew, epi_steps = 0.0, 0
     rewards = collections.deque(maxlen=50)
+    best_epi_rew = -float("inf")  # exploration probe: did ANY episode succeed?
     rng = np.random.default_rng(seed)
 
+    warm_sign = float(rng.choice([-1.0, 1.0]))  # sticky bang-bang warmup state
     seq: list[dict] = []
     ready: list[dict] = []
     # Off-policy replay of sequence windows (capacity in windows, matching the
@@ -137,8 +142,14 @@ def run(
                 # keep the policy carry (h2, c2) consistent with what the
                 # policy *saw*, but override the executed/stored action.
                 if family.continuous:
+                    if rng.random() < warmup_flip_p:
+                        warm_sign = -warm_sign
                     a = jnp.asarray(
-                        rng.uniform(-1.0, 1.0, size=a.shape), jnp.float32
+                        np.clip(
+                            warm_sign + 0.25 * rng.normal(size=a.shape),
+                            -1.0, 1.0,
+                        ),
+                        jnp.float32,
                     )
                 else:
                     a = jnp.asarray(
@@ -170,6 +181,7 @@ def run(
             obs, h, c = next_obs, h2, c2
             if done or epi_steps >= cfg.time_horizon:
                 rewards.append(epi_rew)
+                best_epi_rew = max(best_epi_rew, epi_rew)
                 if (
                     target is not None
                     and len(rewards) == rewards.maxlen
@@ -202,17 +214,27 @@ def run(
             cfg = cfg.replace(
                 entropy_coef=float(anneal["coef"]),
                 lr=float(anneal.get("lr", cfg.lr)),
+                std_floor=float(anneal.get("std_floor", cfg.std_floor)),
             )
+            if "std_floor" in anneal:
+                # std_floor is a static module attribute, not a parameter:
+                # rebuild the family (params carry over unchanged) so acting
+                # and training both use the new floored distribution.
+                from tpu_rl.models.families import build_family
+
+                family = build_family(cfg)
+                act = jax.jit(family.act)
             train_step = jax.jit(spec.make_train_step(cfg, family))
             print(
                 f"update {update}: entropy_coef -> {cfg.entropy_coef}, "
-                f"lr -> {cfg.lr}",
+                f"lr -> {cfg.lr}, std_floor -> {cfg.std_floor}",
                 flush=True,
             )
         if update % log_every == 0:
             print(
                 f"update {update:5d}  loss {float(metrics['loss']):+.4f}  "
-                f"mean-epi-rew {mean50():8.2f}  env-steps {env_steps:7d}  "
+                f"mean-epi-rew {mean50():8.2f}  "
+                f"best {best_epi_rew:8.2f}  env-steps {env_steps:7d}  "
                 f"elapsed {time.time()-t0:6.1f}s",
                 flush=True,
             )
@@ -259,6 +281,9 @@ def run(
         "algo": cfg.algo,
         "env": cfg.env,
         "final_mean_50": mean50(),
+        "best_epi_rew": (
+            round(best_epi_rew, 1) if np.isfinite(best_epi_rew) else None
+        ),
         "target": target,
         "reached_target": hit,
         "time_to_target_s": (

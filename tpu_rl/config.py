@@ -132,6 +132,13 @@ class Config:
     # /root/reference/agents/worker.py:131). 0 disables. With
     # worker_num_envs > 1 the throttle applies per batched tick.
     worker_step_sleep: float = 0.05
+    # Sampling-std lower bound for the Gaussian (PPO-Continuous) policy:
+    # 0 = reference parity (std = softplus(head) alone, models.py:114-118);
+    # > 0 keeps exploration alive on sparse-goal envs (MountainCarContinuous)
+    # where the entropy bonus alone lets the std collapse into the do-nothing
+    # local optimum before the goal is ever found. Sampling and training use
+    # the same floored distribution, so the policy stays exactly on-policy.
+    std_floor: float = 0.0
     # Number of gymnasium envs one worker process steps with a SINGLE batched
     # act() call per tick (TPU-native vectorized acting; the reference is
     # strictly one env per process, /root/reference/agents/worker.py:87-142,
@@ -187,6 +194,10 @@ class Config:
         assert self.attention_impl in ("full", "blockwise", "ring", "ulysses")
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs
+        assert self.std_floor >= 0.0, (
+            f"std_floor must be >= 0 (got {self.std_floor}): a negative floor "
+            "makes the Gaussian std negative and log-probs NaN"
+        )
         if self.worker_num_envs > 1:
             assert self.model == "lstm", (
                 "worker_num_envs>1 requires model='lstm' (the transformer "

@@ -246,7 +246,7 @@ def build_family(cfg: Config, mesh=None) -> ModelFamily:
             act=partial(_act_discrete_ac, actor),
         )
     elif cfg.algo == "PPO-Continuous":
-        actor = ContinuousActorCritic(n_actions=n, **kw)
+        actor = ContinuousActorCritic(n_actions=n, std_floor=cfg.std_floor, **kw)
         fam = ModelFamily(
             cfg.algo, True, False, actor, None, obs_dim, n, cfg.hidden_size,
             act=partial(_act_continuous_ac, actor),

@@ -81,11 +81,19 @@ class DiscreteActorCritic(nn.Module):
 class ContinuousActorCritic(nn.Module):
     """Shared-torso Gaussian actor-critic: ``MlpLSTMContinuous`` in the
     ``MlpLSTMSingleContinuous`` composite (``models.py:103-118,354-361``).
-    mu = tanh(Dense), std = softplus(Dense)."""
+    mu = tanh(Dense), std = softplus(Dense) + std_floor.
+
+    ``std_floor`` (default 0 = reference parity) lower-bounds the sampling
+    std — the standard min-std exploration device for sparse-goal envs where
+    the entropy bonus alone lets the Gaussian collapse before the goal is
+    ever found. Acting and training share this one module, so log-probs are
+    always computed from the SAME floored distribution the actions were
+    sampled from: the policy stays exactly on-policy."""
 
     n_actions: int
     hidden: int = 64
     reset_on_first: bool = True
+    std_floor: float = 0.0
 
     def setup(self):
         self.body = nn.Dense(self.hidden, name="body")
@@ -96,7 +104,7 @@ class ContinuousActorCritic(nn.Module):
 
     def _dist(self, h: jax.Array):
         mu = jnp.tanh(self.mu_head(h))
-        std = nn.softplus(self.std_head(h))
+        std = nn.softplus(self.std_head(h)) + self.std_floor
         return mu, std
 
     def act(self, obs: jax.Array, carry: Carry):
