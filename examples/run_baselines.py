@@ -80,10 +80,17 @@ CONFIGS: dict[str, dict] = {
         # std), gamma ~1 carries the +100 terminal reward through ~999-step
         # episodes, and the anneal drops the floor + entropy once the goal
         # is being exploited so the sampled mean-50 can clear 90.
+        # action_repeat=8 is the decisive piece (measured): iid Gaussian
+        # noise NEVER reaches the goal (0/20 episodes) because zero-mean
+        # per-step forces cancel; the same noise held 8 steps pumps the
+        # resonant swing (16/20). It also shrinks the decision horizon to
+        # ~125 policy steps, so gamma 0.99 suffices and each 320-step batch
+        # covers ~2.5 whole episodes.
         overrides=dict(
-            std_floor=0.35,
+            action_repeat=8,
+            std_floor=0.3,
             entropy_coef=0.005,
-            gamma=0.9999,
+            gamma=0.99,
             batch_size=64,
             time_horizon=999,
             reward_scale=0.1,

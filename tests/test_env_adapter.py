@@ -93,3 +93,47 @@ def test_probe_spaces_conv_accounts_for_preprocessing(monkeypatch):
     )
     assert cfg.obs_shape == (32 * 32,)
     assert cfg.action_space == 3
+
+
+class _CountingEnv:
+    """Counts underlying steps; terminates at step 10."""
+
+    class _Space:
+        n = 2
+
+    action_space = _Space()
+
+    def __init__(self):
+        self.n_steps = 0
+
+    def reset(self, seed=None):
+        return np.zeros(3, np.float32), {}
+
+    def step(self, action):
+        self.n_steps += 1
+        term = self.n_steps >= 10
+        return np.full(3, self.n_steps, np.float32), 1.0, term, False, {}
+
+    def close(self):
+        pass
+
+
+def test_action_repeat_sums_rewards_and_stops_on_done():
+    """action_repeat holds one policy action k underlying steps, sums the
+    rewards, and cuts the repeat short at termination (frame-skip)."""
+    cfg = small_config(action_repeat=4)
+    env = EnvAdapter.__new__(EnvAdapter)
+    env.cfg = cfg
+    env.env = _CountingEnv()
+    env._seed = None
+    env._continuous = False
+    env._act_space = _CountingEnv.action_space
+    env.reset()
+    obs, rew, done = env.step(np.asarray([0.0]))
+    assert env.env.n_steps == 4 and rew == 4.0 and not done
+    obs, rew, done = env.step(np.asarray([1.0]))
+    assert env.env.n_steps == 8 and rew == 4.0 and not done
+    # third repeat hits termination at underlying step 10: only 2 steps taken
+    obs, rew, done = env.step(np.asarray([0.0]))
+    assert env.env.n_steps == 10 and rew == 2.0 and done
+    assert obs[0] == 10.0

@@ -132,6 +132,11 @@ class Config:
     # /root/reference/agents/worker.py:131). 0 disables. With
     # worker_num_envs > 1 the throttle applies per batched tick.
     worker_step_sleep: float = 0.05
+    # Hold each policy action for k underlying env steps (frame-skip),
+    # summing rewards; 1 = reference parity (no repeat). Shrinks the
+    # decision horizon k-fold and makes exploration noise piecewise-
+    # constant (see EnvAdapter.step).
+    action_repeat: int = 1
     # Sampling-std lower bound for the Gaussian (PPO-Continuous) policy:
     # 0 = reference parity (std = softplus(head) alone, models.py:114-118);
     # > 0 keeps exploration alive on sparse-goal envs (MountainCarContinuous)
@@ -194,6 +199,7 @@ class Config:
         assert self.attention_impl in ("full", "blockwise", "ring", "ulysses")
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs
+        assert self.action_repeat >= 1, self.action_repeat
         assert self.std_floor >= 0.0, (
             f"std_floor must be >= 0 (got {self.std_floor}): a negative floor "
             "makes the Gaussian std negative and log-probs NaN"

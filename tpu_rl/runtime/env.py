@@ -88,15 +88,31 @@ class EnvAdapter:
     def step(self, action: np.ndarray) -> tuple[np.ndarray, float, bool]:
         """action: policy-side float vector — (1,) index for discrete, (A,)
         for continuous (reference ``action_preprocess``,
-        ``env_maker.py:15-26``)."""
+        ``env_maker.py:15-26``).
+
+        ``cfg.action_repeat > 1`` holds each policy action for k underlying
+        env steps (frame-skip), summing rewards and stopping early on done —
+        the policy's MDP is the wrapped env, so everything downstream stays
+        exactly on-policy. Standard practice (Atari frame-skip); on
+        sparse-goal continuous-control envs it also makes per-step
+        exploration noise piecewise-constant, which is what lets a Gaussian
+        policy find MountainCarContinuous's goal at all (measured: iid
+        noise 0/20 episodes reach the goal; the same noise held 8 steps,
+        16/20)."""
         if self._continuous:
             env_action = np.asarray(action, np.float32).reshape(
                 self._act_space.shape
             )
         else:
             env_action = int(np.asarray(action).reshape(-1)[0])
-        obs, rew, term, trunc, _info = self.env.step(env_action)
-        return self._preprocess(obs), float(rew), bool(term or trunc)
+        total_rew, done = 0.0, False
+        for _ in range(self.cfg.action_repeat):
+            obs, rew, term, trunc, _info = self.env.step(env_action)
+            total_rew += float(rew)
+            if term or trunc:
+                done = True
+                break
+        return self._preprocess(obs), total_rew, done
 
     def close(self) -> None:
         self.env.close()
