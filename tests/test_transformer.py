@@ -289,3 +289,40 @@ class TestTransformerActing:
         kv = cfg.n_layers * 8 * cfg.hidden_size
         assert fam.carry_widths == (kv, kv + 1)
         assert not fam.store_carry
+
+
+class TestVectorizedTransformerActing:
+    def test_batched_act_matches_per_row_acting(self, rng):
+        """Per-row KV-cache counters: a batch of envs at DIFFERENT episode
+        steps, acted in one call, must produce exactly the logits each env
+        would get acted alone (the vectorized worker's correctness
+        contract). Rows are desynchronized by resetting env 1's carry
+        mid-run (fresh episode), as the worker does."""
+        cfg = _tf_config(act_ctx=8)
+        fam = build_family(cfg)
+        params = fam.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        act = jax.jit(fam.act)
+        hw, cw = fam.carry_widths
+        N = 3
+        h = jnp.zeros((N, hw))
+        c = jnp.zeros((N, cw))
+        # independent single-env references
+        hs = [jnp.zeros((1, hw)) for _ in range(N)]
+        cs = [jnp.zeros((1, cw)) for _ in range(N)]
+        for t in range(10):
+            obs = jnp.asarray(rng.normal(size=(N, 4)).astype(np.float32))
+            key = jax.random.key(t)
+            _a, logits, _lp, h, c = act(params, obs, h, c, key)
+            for i in range(N):
+                _ai, li, _lpi, hs[i], cs[i] = act(
+                    params, obs[i : i + 1], hs[i], cs[i], key
+                )
+                np.testing.assert_allclose(
+                    np.asarray(logits[i]), np.asarray(li[0]),
+                    rtol=1e-5, atol=1e-5,
+                )
+            if t == 4:  # desynchronize: env 1 starts a new episode
+                h = h.at[1].set(0.0)
+                c = c.at[1].set(0.0)
+                hs[1] = jnp.zeros((1, hw))
+                cs[1] = jnp.zeros((1, cw))

@@ -149,8 +149,8 @@ class Config:
     # strictly one env per process, /root/reference/agents/worker.py:87-142,
     # capping each process at ~20 env-steps/s). Batching the policy forward
     # amortizes dispatch overhead, so one process sustains ~N x the reference
-    # per-process throughput. LSTM backbone only (the transformer acting
-    # carry packs a per-env KV cache + step counter that assumes batch 1).
+    # per-process throughput. Works for every backbone: the transformer
+    # acting carry packs per-env KV caches with per-row step counters.
     worker_num_envs: int = 1
     # RolloutAssembler idle-trajectory drop window, seconds
     # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
@@ -204,11 +204,6 @@ class Config:
             f"std_floor must be >= 0 (got {self.std_floor}): a negative floor "
             "makes the Gaussian std negative and log-probs NaN"
         )
-        if self.worker_num_envs > 1:
-            assert self.model == "lstm", (
-                "worker_num_envs>1 requires model='lstm' (the transformer "
-                "acting carry packs a per-env KV cache that assumes batch 1)"
-            )
         if self.mesh_seq > 1:
             assert self.model == "transformer", (
                 "sequence parallelism (mesh_seq>1) requires model='transformer'"

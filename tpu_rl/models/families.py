@@ -151,17 +151,18 @@ def _act_transformer(
     token's K/V as originally computed — a policy-lag-like bias absorbed by
     the IS/V-trace corrections."""
     head_d = hidden // n_heads
-    k_caches = h.reshape(1, n_layers, ctx, n_heads, head_d)
-    v_caches = c[:, :-1].reshape(1, n_layers, ctx, n_heads, head_d)
-    count = c[0, -1].astype(jnp.int32)
+    B = h.shape[0]
+    k_caches = h.reshape(B, n_layers, ctx, n_heads, head_d)
+    v_caches = c[:, :-1].reshape(B, n_layers, ctx, n_heads, head_d)
+    count = c[:, -1].astype(jnp.int32)  # (B,) — per env row
     logits, _value, k2, v2 = actor.apply(
         params["actor"], obs, k_caches, v_caches, count, method="decode"
     )
     a = D.categorical_sample(key, logits)
     log_prob = D.categorical_log_prob(logits, a)
-    h2 = k2.reshape(1, -1)
+    h2 = k2.reshape(B, -1)
     c2 = jnp.concatenate(
-        [v2.reshape(1, -1), (count + 1).astype(jnp.float32)[None, None]], axis=1
+        [v2.reshape(B, -1), (count + 1).astype(jnp.float32)[:, None]], axis=1
     )
     return a[..., None].astype(jnp.float32), logits, log_prob[..., None], h2, c2
 

@@ -115,28 +115,31 @@ class MultiHeadAttention(nn.Module):
         x_t: jax.Array,  # (B, 1, C) — the newest token only
         k_cache: jax.Array,  # (B, ctx, H, D)
         v_cache: jax.Array,  # (B, ctx, H, D)
-        count: jax.Array,  # scalar int32: tokens already cached this episode
+        count: jax.Array,  # (B,) int32: tokens already cached, per row
     ):
         """One incremental step: project the new token, ring-write its K/V
         into the cache at ``count % ctx``, attend the query over the valid
         cache entries. All cached tokens precede the query, so causality is
-        exactly the validity mask."""
+        exactly the validity mask. ``count`` is per-row so a vectorized
+        worker can carry envs at different episode steps in one batch."""
         B, _, C = x_t.shape
         H = self.n_heads
         ctx = k_cache.shape[1]
         qkv = self.qkv(x_t).reshape(B, 1, 3, H, C // H)
         q, k_new, v_new = qkv[:, 0, 0], qkv[:, 0, 1], qkv[:, 0, 2]  # (B,H,D)
-        slot = jnp.mod(count, ctx)
-        # The worker carry (and thus the caches) is float32; bf16 projections
-        # round-trip exactly through the f32 store, so casting back to the
-        # compute dtype below reproduces the training path's inputs bit-for-bit.
-        k_cache = jax.lax.dynamic_update_slice_in_dim(
-            k_cache, k_new.astype(k_cache.dtype)[:, None], slot, axis=1
-        )
-        v_cache = jax.lax.dynamic_update_slice_in_dim(
-            v_cache, v_new.astype(v_cache.dtype)[:, None], slot, axis=1
-        )
-        valid = jnp.arange(ctx) <= count  # ring not yet wrapped: prefix only
+        slot = jnp.mod(count, ctx)  # (B,)
+        # Per-row ring write via boolean select (dynamic_update_slice cannot
+        # take per-row start indices; a where() is a true overwrite, so a
+        # transient NaN projection cannot poison the slot the way an
+        # arithmetic 0*NaN blend would). The worker carry (and thus the
+        # caches) is float32; bf16 projections round-trip exactly through the
+        # f32 store, so casting back to the compute dtype below reproduces
+        # the training path's inputs bit-for-bit.
+        write = (jnp.arange(ctx)[None, :] == slot[:, None])[:, :, None, None]
+        k_cache = jnp.where(write, k_new.astype(k_cache.dtype)[:, None], k_cache)
+        v_cache = jnp.where(write, v_new.astype(v_cache.dtype)[:, None], v_cache)
+        # ring not yet wrapped: prefix only, per row
+        valid = jnp.arange(ctx)[None, :] <= count[:, None]  # (B, ctx)
         # Mixed-precision recipe mirrors full_attention/_masked_block_scores:
         # compute-dtype (possibly bf16) operands into the MXU, float32
         # accumulation and softmax.
@@ -145,7 +148,7 @@ class MultiHeadAttention(nn.Module):
         scores = jnp.einsum(
             "bhd,bthd->bht", q, kc, preferred_element_type=jnp.float32
         ) * jnp.float32(1.0 / np.sqrt(C / H))
-        scores = jnp.where(valid[None, None, :], scores, -jnp.inf)
+        scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
         w = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum(
             "bht,bthd->bhd", w, vc, preferred_element_type=jnp.float32
@@ -266,13 +269,13 @@ class TransformerActorCritic(nn.Module):
         obs_t: jax.Array,  # (B, obs_dim) — the newest observation
         k_caches: jax.Array,  # (B, n_layers, ctx, H, D)
         v_caches: jax.Array,  # (B, n_layers, ctx, H, D)
-        count: jax.Array,  # scalar int32: tokens already cached this episode
+        count: jax.Array,  # (B,) int32: tokens already cached, per row
     ):
         """Incremental acting step. The position is episode-relative
         (= ``count``), matching the training unroll's segment-relative
-        positions while the episode fits the window."""
-        B = obs_t.shape[0]
-        pos = jnp.full((B, 1), count, jnp.int32)
+        positions while the episode fits the window. Per-row counts let a
+        vectorized worker batch envs at different episode steps."""
+        pos = count[:, None].astype(jnp.int32)
         x = self.embed(obs_t[:, None, :])
         x = x + sinusoidal_embedding(pos, self.hidden).astype(x.dtype)
         new_k, new_v = [], []

@@ -147,10 +147,13 @@ class Worker:
                         episode_ids[i] = uuid.uuid4().hex
                         is_fir[i], epi_rew[i], epi_steps[i] = 1.0, 0.0, 0
 
-                # Carry forward; zero only the rows whose episode ended.
+                # Carry forward; zero only the rows whose episode ended
+                # (where(), not multiply: a transient NaN in a dying
+                # episode's carry must not survive the reset as NaN*0).
                 if reset_rows.any():
-                    keep = jnp.asarray(1.0 - reset_rows)[:, None]
-                    h, c = h2 * keep, c2 * keep
+                    keep = jnp.asarray(reset_rows == 0.0)[:, None]
+                    h = jnp.where(keep, h2, 0.0)
+                    c = jnp.where(keep, c2, 0.0)
                 else:
                     h, c = h2, c2
 
