@@ -111,8 +111,13 @@ CONFIGS: dict[str, dict] = {
         # scripted episodes reach the goal — so the replay actually contains
         # goal (+100) rewards; gamma ~1 carries that signal back through the
         # ~999-step episodes.
+        # buffer_size must hold the goal-rich warmup windows for the WHOLE
+        # run: with 8192 windows (~41k steps) the warmup data was evicted
+        # ~30k post-warmup steps in, and a seed that hadn't locked on by
+        # then (seed 1) never recovered; 32768 windows (~164k steps) out-
+        # lives the 150k-step budget.
         overrides=dict(
-            time_horizon=999, reward_scale=0.1, lr=3e-4, buffer_size=8192,
+            time_horizon=999, reward_scale=0.1, lr=3e-4, buffer_size=32768,
             gamma=0.999, warmup_steps=10_000,
         ),
     ),
@@ -161,13 +166,13 @@ def main() -> None:
     print(f"wrote {args.out}", flush=True)
     # companion markdown table (committed alongside the JSON)
     md = [
-        "| algo | env | target | reached | time-to-target (s) | "
+        "| algo | env | seed | target | reached | time-to-target (s) | "
         "50-game mean | greedy eval | updates | env steps | steps/s |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
-    for r in sorted(merged, key=lambda r: r["algo"]):
+    for r in sorted(merged, key=lambda r: (r["algo"], r.get("seed", 0))):
         md.append(
-            "| {algo} | {env} | {target} | {reached_target} | "
+            "| {algo} | {env} | {seed} | {target} | {reached_target} | "
             "{time_to_target_s} | {final_mean_50:.1f} | {ge} | {updates} | "
             "{env_steps} | {env_steps_per_s} |".format(
                 ge=(
@@ -175,7 +180,8 @@ def main() -> None:
                     if r.get("greedy_eval_mean_20") is not None
                     else "—"
                 ),
-                **r,
+                seed=r.get("seed", 0),  # legacy rows predate the seed field
+                **{k: v for k, v in r.items() if k != "seed"},
             )
         )
     with open(os.path.splitext(args.out)[0] + ".md", "w") as f:

@@ -40,7 +40,11 @@ def main() -> None:
     from tpu_rl.config import Config, MachinesConfig, WorkerMachine
     from tpu_rl.runtime.runner import local_cluster
 
-    run_dir = os.path.abspath(args.run_dir)
+    # Fresh timestamped subdir per invocation: stale event files from a
+    # previous run would otherwise merge into the reward curve.
+    run_dir = os.path.abspath(
+        os.path.join(args.run_dir, time.strftime("%Y%m%d-%H%M%S"))
+    )
     os.makedirs(run_dir, exist_ok=True)
     cfg = Config.from_dict(
         dict(
@@ -74,12 +78,14 @@ def main() -> None:
         ],
     )
     t0 = time.time()
+    deadline = t0 + 3600.0  # hard wallclock cap: never spin forever
     sup = local_cluster(cfg, machines, max_updates=args.updates)
     try:
         learner = next(c for c in sup.children if c.name == "learner")
-        while learner.proc.is_alive():
+        while learner.proc.is_alive() and time.time() < deadline:
+            sup.check()  # restart-on-silence supervision for the other roles
             time.sleep(2.0)
-        rc = learner.proc.exitcode
+        rc = learner.proc.exitcode if not learner.proc.is_alive() else None
     finally:
         sup.stop()
     wallclock = time.time() - t0

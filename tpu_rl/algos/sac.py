@@ -31,8 +31,21 @@ sg = jax.lax.stop_gradient
 
 def make_train_step(cfg: Config, family: ModelFamily):
     opt_actor, opt_critic, opt_alpha = adam(cfg), adam(cfg), adam(cfg)
-    target_entropy = float(cfg.action_space)
     continuous = family.continuous
+    # Target entropy — documented divergence from the reference, which sets
+    # target = +action_space for BOTH variants (``learner.py:363-365``).
+    # That target is unreachable for a tanh-squashed Gaussian (support
+    # (-1,1)^A caps differential entropy at A*log2 < A), and together with
+    # the reference's alpha-loss sign (below) the temperature never
+    # equilibrates. Standard practice instead: continuous -dim(A)
+    # (Haarnoja et al. 2018), discrete 0.98*log|A| (Christodoulou 2019).
+    # cfg.target_entropy overrides the rule when set.
+    if cfg.target_entropy is not None:
+        target_entropy = float(cfg.target_entropy)
+    elif continuous:
+        target_entropy = -float(cfg.action_space)
+    else:
+        target_entropy = 0.98 * float(jnp.log(cfg.action_space))
 
     def _critic_apply(cp, batch: Batch, act, carry0):
         if continuous:
@@ -53,8 +66,13 @@ def make_train_step(cfg: Config, family: ModelFamily):
                 a_pol, logp = tanh_normal_sample(k_pol, mu, jnp.exp(log_std))
                 q1, q2 = _critic_apply(state.critic_params, batch, a_pol, carry0)
                 min_q = jnp.minimum(q1, q2)
-                loss_policy = jnp.mean((alpha_d * logp - min_q)[:, :-1])
-                ent_neg = logp[:, :-1]  # per-dim -entropy estimate
+                # total log-prob: per-dim log-probs summed over action dims,
+                # so the entropy coefficient the policy feels and the
+                # -dim(A) target the controller tunes against agree for any
+                # action dimensionality
+                logp_tot = jnp.sum(logp, axis=-1, keepdims=True)
+                loss_policy = jnp.mean((alpha_d * logp_tot - min_q)[:, :-1])
+                ent_neg = logp_tot[:, :-1, 0]
             else:
                 probs, logp = family.actor_unroll(ap, batch.obs, carry0, fir)
                 q1, q2 = _critic_apply(state.critic_params, batch, None, carry0)
@@ -72,9 +90,15 @@ def make_train_step(cfg: Config, family: ModelFamily):
         up, actor_opt = opt_actor.update(g_actor, state.actor_opt, state.actor_params)
         actor_params = optax.apply_updates(state.actor_params, up)
 
-        # ---- 2) temperature update (sac/learning.py:64-74)
+        # ---- 2) temperature update (sac/learning.py:64-74). Documented
+        # divergence: the reference computes +alpha*(logpi + target), whose
+        # feedback runs BACKWARDS (an entropy deficit shrinks alpha toward 0,
+        # killing exploration — measured on MountainCarContinuous: 2/3 seeds
+        # collapse, greedy as low as -69). Standard SAC minimizes
+        # -alpha*(logpi + target): deficit -> alpha grows -> more entropy
+        # pressure; surplus -> alpha shrinks.
         def alpha_loss_fn(log_alpha):
-            return jnp.mean(jnp.exp(log_alpha) * (sg(ent_neg) + target_entropy))
+            return -jnp.mean(jnp.exp(log_alpha) * (sg(ent_neg) + target_entropy))
 
         loss_alpha, g_alpha = jax.value_and_grad(alpha_loss_fn)(state.log_alpha)
         up, alpha_opt = opt_alpha.update(g_alpha, state.alpha_opt, state.log_alpha)
@@ -88,7 +112,12 @@ def make_train_step(cfg: Config, family: ModelFamily):
             tq1, tq2 = _critic_apply(
                 state.target_critic_params, batch, a_cri, carry0
             )
-            soft_q = jnp.minimum(tq1, tq2) - alpha2 * logp_cri
+            # total log-prob (see the actor loss): keeps the TD target's
+            # entropy bonus dimension-correct and leaves soft_q (B, T, 1),
+            # so the shared sum() below is a no-op for this branch
+            soft_q = jnp.minimum(tq1, tq2) - alpha2 * jnp.sum(
+                logp_cri, axis=-1, keepdims=True
+            )
         else:
             probs_cri, logp_cri = family.actor_unroll(
                 actor_params, batch.obs, carry0, fir

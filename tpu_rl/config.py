@@ -74,6 +74,10 @@ class Config:
     # SAC
     alpha: float = 0.2
     tau: float = 0.005
+    # SAC temperature target entropy; None = standard auto rule
+    # (-dim(A) continuous, 0.98*log|A| discrete — see algos/sac.py for the
+    # documented divergence from the reference's +action_space).
+    target_entropy: float | None = None
 
     # V-trace clipping (reference hard-codes rho in [0.1, 0.8], c_bar = 1.0,
     # /root/reference/agents/learner_module/compute_loss.py:29-43)
