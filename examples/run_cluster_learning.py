@@ -35,6 +35,7 @@ def main() -> None:
     p.add_argument("--num-envs", type=int, default=8)
     p.add_argument("--out", default=None, help="markdown run-record path")
     p.add_argument("--run-dir", default="runs/cluster_learning")
+    p.add_argument("--base-port", type=int, default=30100)
     args = p.parse_args()
 
     from tpu_rl.config import Config, MachinesConfig, WorkerMachine
@@ -53,9 +54,28 @@ def main() -> None:
             batch_size=32,
             seq_len=5,
             hidden_size=64,
-            lr=3e-4,
-            entropy_coef=0.001,
-            worker_step_sleep=0.0,
+            # Stronger entropy bonus than the inline runs. On its own it is
+            # NOT sufficient: without zero_window_carry the softmax saturated
+            # to entropy exactly 0.0 at coef 0.001, 0.01 AND 0.05 (advantage
+            # noise from hallucinated values overwhelms any bonus); with
+            # zero_window_carry + the fleet throttle below, 0.01 holds
+            # entropy ~0.58 for the whole recorded run.
+            lr=1.5e-4,
+            entropy_coef=0.01,
+            # Decisive for async learning (measured): without zero-init the
+            # stale actor-stored carries drive bootstrapped value
+            # hallucination (mean V > discounted cap) -> persistent negative
+            # advantages -> entropy ratchets to exactly 0 regardless of the
+            # entropy bonus (collapse observed at coef 0.001, 0.01 AND 0.05).
+            zero_window_carry=True,
+            # Throttle the fleet to just above the learner's consumption
+            # rate (~500 transitions/s at 3 updates/s): on a single shared
+            # core, unthrottled workers flood the relay queues and data ages
+            # in flight — measured V-trace ratios fell to ~0.5 (heavy lag),
+            # where the rho-clipped corrections are too weak to keep the
+            # value function honest (mean V drifted past the discounted
+            # cap). Near-empty queues keep the behavior policy fresh.
+            worker_step_sleep=0.02,
             worker_num_envs=args.num_envs,
             learner_device="cpu",  # deterministic on shared hosts; the
             # real-TPU topology is separately recorded in RUN_LOCAL_TPU_r03.md
@@ -69,11 +89,11 @@ def main() -> None:
     )
     machines = MachinesConfig(
         learner_ip="127.0.0.1",
-        learner_port=30100,
+        learner_port=args.base_port,
         workers=[
             WorkerMachine(
                 num_p=args.workers, manager_ip="127.0.0.1", ip="127.0.0.1",
-                port=30102,
+                port=args.base_port + 2,
             )
         ],
     )

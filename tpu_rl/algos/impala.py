@@ -27,7 +27,7 @@ def make_train_step(cfg: Config, family: ModelFamily):
     opt = rmsprop(cfg)
 
     def loss_fn(params, batch: Batch):
-        log_probs, entropy, value, _ = policy_outputs(family, params, batch)
+        log_probs, entropy, value, logits = policy_outputs(family, params, batch)
 
         ratio, advantages, values_target = vtrace(
             behav_log_probs=batch.log_prob,
@@ -58,6 +58,14 @@ def make_train_step(cfg: Config, family: ModelFamily):
             "min-ratio": jnp.min(ratio),
             "max-ratio": jnp.max(ratio),
             "avg-ratio": jnp.mean(ratio),
+            # Saturation diagnostics: a categorical policy hits entropy
+            # exactly 0 once logit gaps exceed ~90 (float32 one-hot); these
+            # localize whether a collapse is advantage-driven or a logit
+            # runaway (observed while diagnosing the async-cluster runs).
+            "max-abs-logit": jnp.max(jnp.abs(logits)),
+            "mean-value": jnp.mean(value),
+            "max-abs-advantage": jnp.max(jnp.abs(advantages)),
+            "mean-advantage": jnp.mean(advantages),
         }
         return loss, metrics
 

@@ -136,6 +136,12 @@ class Config:
     # /root/reference/agents/worker.py:131). 0 disables. With
     # worker_num_envs > 1 the throttle applies per batched tick.
     worker_step_sleep: float = 0.05
+    # R2D2-style zero-init of the recurrent carry at training-window starts
+    # (learner side). The reference trains from the actor-stored stale carry
+    # (ppo/learning.py:37-40); under async fleet lag those off-manifold
+    # hidden states measurably drive bootstrapped value hallucination
+    # (mean V above the discounted cap). False = reference parity.
+    zero_window_carry: bool = False
     # Hold each policy action for k underlying env steps (frame-skip),
     # summing rewards; 1 = reference parity (no repeat). Shrinks the
     # decision horizon k-fold and makes exploration noise piecewise-
