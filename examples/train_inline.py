@@ -26,7 +26,7 @@ import numpy as np
 from tpu_rl.algos.registry import get_algo
 from tpu_rl.config import Config, is_off_policy
 from tpu_rl.runtime.env import EnvAdapter, probe_spaces
-from tpu_rl.types import BATCH_FIELDS, Batch
+from tpu_rl.types import BATCH_FIELDS, Batch, maybe_zero_carry
 
 
 def act_params(state):
@@ -205,7 +205,9 @@ def run(
         else:
             picked, ready = ready, []
         batch = Batch.from_mapping(
-            {k: np.stack([t[k] for t in picked]) for k in BATCH_FIELDS}
+            maybe_zero_carry(
+                cfg, {k: np.stack([t[k] for t in picked]) for k in BATCH_FIELDS}
+            )
         )
         key, sub = jax.random.split(key)
         state, metrics = train_step(state, batch, sub)

@@ -69,7 +69,7 @@ def test_continuous_warmup_and_greedy_eval():
         batch_size=4,
         overrides=dict(
             hidden_size=16, buffer_size=16, warmup_steps=10_000,
-            time_horizon=30,
+            time_horizon=30, zero_window_carry=True,
         ),
     )
     assert stats["updates"] == 3

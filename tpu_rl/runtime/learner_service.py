@@ -205,20 +205,9 @@ class LearnerService:
             self._place_global = sharding
 
     def _to_batch(self, raw: dict):
-        from tpu_rl.types import Batch
+        from tpu_rl.types import Batch, maybe_zero_carry
 
-        if self.cfg.zero_window_carry:
-            # R2D2-style zero-init of the recurrent window carry: the stored
-            # carries were produced by a policy several updates old (the
-            # actor fleet's lag), and bootstrapping values off those
-            # off-manifold hidden states measurably hallucinates returns
-            # above the discounted cap in the async cluster (deadly triad).
-            # Zero-init trades a little short-window context for on-manifold
-            # features. The reference always trusts the stale carry
-            # (``ppo/learning.py:37-40``); default False = parity.
-            raw = dict(raw)
-            raw["hx"] = np.zeros_like(raw["hx"])
-            raw["cx"] = np.zeros_like(raw["cx"])
+        raw = maybe_zero_carry(self.cfg, raw)
         if self._place_global is not None:
             from tpu_rl.parallel.multihost import host_local_batch_to_global
 

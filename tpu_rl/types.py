@@ -120,3 +120,18 @@ class Batch:
 
 def batch_to_numpy(b: Batch) -> dict[str, np.ndarray]:
     return {k: np.asarray(getattr(b, k)) for k in BATCH_FIELDS}
+
+
+def maybe_zero_carry(cfg, mapping: dict) -> dict:
+    """R2D2-style zero-init of the training-window recurrent carry, gated on
+    ``cfg.zero_window_carry``: stored carries come from the (possibly long
+    gone) behavior policy, and bootstrapping values off those off-manifold
+    hidden states measurably drives value hallucination (CLUSTER_LEARNING.md).
+    The reference always trusts the stale carry (``ppo/learning.py:37-40``);
+    default False = parity. Returns a shallow copy when zeroing."""
+    if not getattr(cfg, "zero_window_carry", False):
+        return mapping
+    out = dict(mapping)
+    out["hx"] = np.zeros_like(mapping["hx"])
+    out["cx"] = np.zeros_like(mapping["cx"])
+    return out
