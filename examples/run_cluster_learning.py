@@ -36,6 +36,10 @@ def main() -> None:
     p.add_argument("--out", default=None, help="markdown run-record path")
     p.add_argument("--run-dir", default="runs/cluster_learning")
     p.add_argument("--base-port", type=int, default=30100)
+    # Standard V-trace truncation is rho_bar=1 (no floor); the defaults keep
+    # the reference's [0.1, 0.8] clip (compute_loss.py:29-43) for parity.
+    p.add_argument("--rho-bar", type=float, default=0.8)
+    p.add_argument("--rho-min", type=float, default=0.1)
     args = p.parse_args()
 
     from tpu_rl.config import Config, MachinesConfig, WorkerMachine
@@ -68,6 +72,8 @@ def main() -> None:
             # advantages -> entropy ratchets to exactly 0 regardless of the
             # entropy bonus (collapse observed at coef 0.001, 0.01 AND 0.05).
             zero_window_carry=True,
+            rho_bar=args.rho_bar,
+            rho_min=args.rho_min,
             # Throttle the fleet to just above the learner's consumption
             # rate (~500 transitions/s at 3 updates/s): on a single shared
             # core, unthrottled workers flood the relay queues and data ages
