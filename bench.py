@@ -22,7 +22,11 @@ its by-construction ceiling is 600 transitions/s (3 machines x 10 workers x
 
 stdout: ONE JSON line {"metric", "value", "unit", "vs_baseline"} (the IMPALA
 reference-quantum row — same headline as rounds 1-2).
-Full matrix: written to ``bench_results.json`` and printed to stderr.
+Full matrix: printed to stderr and written to ``bench_results.json`` — but
+only for a full run on an accelerator. CPU-backend runs write
+``bench_results.cpu.json`` and ``TPU_RL_BENCH_LIGHT`` (partial @ref-only
+matrix) writes ``bench_results.light.json``, so the committed on-chip table
+is never clobbered by fallback or partial numbers.
 """
 
 from __future__ import annotations
@@ -196,13 +200,25 @@ WORKLOADS: list[tuple[str, dict, int, int]] = [
 ]
 
 
-def run_all(out_path: str = "bench_results.json") -> dict:
+def run_all(out_path: str | None = None) -> dict:
     rows = []
     workloads = WORKLOADS
-    if os.environ.get("TPU_RL_BENCH_LIGHT"):
-        # CPU-fallback mode: the MXU-saturating rows take many minutes per
+    on_cpu = jax.devices()[0].platform == "cpu"
+    light = bool(os.environ.get("TPU_RL_BENCH_LIGHT")) or on_cpu
+    if light:
+        # CPU / light mode: the MXU-saturating rows take many minutes per
         # compile on a host core and measure nothing meaningful there.
         workloads = [w for w in WORKLOADS if w[0].endswith("@ref")]
+    if out_path is None:
+        # Never clobber the committed on-chip table with host-CPU numbers or
+        # a partial (light) matrix (round 3 lost its TPU record exactly this
+        # way): only a full run on an accelerator writes the canonical file.
+        if on_cpu:
+            out_path = "bench_results.cpu.json"
+        elif light:
+            out_path = "bench_results.light.json"
+        else:
+            out_path = "bench_results.json"
     for name, cfg_kw, warmup, iters in workloads:
         try:
             row = bench_one(name, cfg_kw, warmup, iters)
@@ -226,12 +242,15 @@ def run_all(out_path: str = "bench_results.json") -> dict:
     )
     if headline is None:
         return dict(ZERO_HEADLINE)
-    return {
+    out = {
         "metric": "learner FPS (IMPALA V-trace, batch 128 x seq 5)",
         "value": headline["tps"],
         "unit": "transitions/sec",
         "vs_baseline": round(headline["tps"] / REFERENCE_BASELINE_TPS, 2),
     }
+    if on_cpu:
+        out["note"] = "CPU backend (no accelerator); matrix in " + out_path
+    return out
 
 
 def run(warmup: int = 10, iters: int = 200) -> dict:
