@@ -40,6 +40,22 @@ def main() -> None:
     # the reference's [0.1, 0.8] clip (compute_loss.py:29-43) for parity.
     p.add_argument("--rho-bar", type=float, default=0.8)
     p.add_argument("--rho-min", type=float, default=0.1)
+    # Hyperparameters default to the inline-solved IMPALA recipe
+    # (examples/run_baselines.py): hot exploration phase then a
+    # near-deterministic tail. The round-3 run held entropy_coef=0.01
+    # forever, which pins policy entropy ~0.58 — a CartPole policy that
+    # flips actions ~28% of the time cannot balance 500 steps, so the fleet
+    # mean was capped near 50 independent of any lag effect.
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--entropy-coef", type=float, default=1e-3)
+    p.add_argument("--anneal-coef", type=float, default=5e-5)
+    p.add_argument("--anneal-lr", type=float, default=1e-4)
+    p.add_argument("--anneal-frac", type=float, default=0.4)
+    p.add_argument("--no-anneal", action="store_true")
+    p.add_argument("--worker-step-sleep", type=float, default=0.02)
+    p.add_argument("--target", type=float, default=475.0,
+                   help="stop early when the fleet 50-game mean reaches this")
+    p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
     from tpu_rl.config import Config, MachinesConfig, WorkerMachine
@@ -58,14 +74,18 @@ def main() -> None:
             batch_size=32,
             seq_len=5,
             hidden_size=64,
-            # Stronger entropy bonus than the inline runs. On its own it is
-            # NOT sufficient: without zero_window_carry the softmax saturated
-            # to entropy exactly 0.0 at coef 0.001, 0.01 AND 0.05 (advantage
-            # noise from hallucinated values overwhelms any bonus); with
-            # zero_window_carry + the fleet throttle below, 0.01 holds
-            # entropy ~0.58 for the whole recorded run.
-            lr=1.5e-4,
-            entropy_coef=0.01,
+            lr=args.lr,
+            entropy_coef=args.entropy_coef,
+            entropy_anneal=(
+                None
+                if args.no_anneal
+                else {
+                    "coef": args.anneal_coef,
+                    "lr": args.anneal_lr,
+                    "frac": args.anneal_frac,
+                }
+            ),
+            stop_at_reward=args.target,
             # Decisive for async learning (measured): without zero-init the
             # stale actor-stored carries drive bootstrapped value
             # hallucination (mean V > discounted cap) -> persistent negative
@@ -81,7 +101,7 @@ def main() -> None:
             # where the rho-clipped corrections are too weak to keep the
             # value function honest (mean V drifted past the discounted
             # cap). Near-empty queues keep the behavior policy fresh.
-            worker_step_sleep=0.02,
+            worker_step_sleep=args.worker_step_sleep,
             worker_num_envs=args.num_envs,
             learner_device="cpu",  # deterministic on shared hosts; the
             # real-TPU topology is separately recorded in RUN_LOCAL_TPU_r03.md
@@ -105,7 +125,7 @@ def main() -> None:
     )
     t0 = time.time()
     deadline = t0 + 3600.0  # hard wallclock cap: never spin forever
-    sup = local_cluster(cfg, machines, max_updates=args.updates)
+    sup = local_cluster(cfg, machines, max_updates=args.updates, seed=args.seed)
     try:
         learner = next(c for c in sup.children if c.name == "learner")
         while learner.proc.is_alive() and time.time() < deadline:
@@ -131,6 +151,7 @@ def main() -> None:
                 for s in acc.Scalars("50-game-mean-stat-of-epi-rew")
             ]
     curve.sort()
+    fleet_max = max((v for _, v in curve), default=None)
     result = dict(
         algo=cfg.algo,
         env=cfg.env,
@@ -139,9 +160,12 @@ def main() -> None:
         wallclock_s=round(wallclock, 1),
         workers=args.workers,
         num_envs_per_worker=args.num_envs,
+        seed=args.seed,
+        target=args.target,
+        solved=(fleet_max is not None and fleet_max >= args.target),
         fleet_reward_first=curve[0][1] if curve else None,
         fleet_reward_last=curve[-1][1] if curve else None,
-        fleet_reward_max=max((v for _, v in curve), default=None),
+        fleet_reward_max=fleet_max,
         n_stat_points=len(curve),
     )
     print(json.dumps(result), flush=True)

@@ -98,6 +98,20 @@ class Config:
     K_epoch: int = 1
     lr: float = 0.0001
     max_grad_norm: float = 40.0
+    # Two-phase entropy/lr anneal, applied by both the inline harness and the
+    # distributed learner (LearnerService): after a switch point the run
+    # continues with {"coef": final_entropy_coef, "lr": final_lr (optional)}.
+    # The switch point is {"at": update_index} absolute, or {"frac": f} as a
+    # fraction of the run's update budget (inline: the updates arg; cluster:
+    # max_updates). High early exploration, then a near-deterministic
+    # low-variance tail — capped-return targets (CartPole 500) need it
+    # (measured: a fixed entropy bonus that keeps entropy ~0.58 caps the
+    # 50-game mean near 50; see BASELINE_RESULTS.md / CLUSTER_LEARNING.md).
+    entropy_anneal: dict | None = None
+    # Distributed learner early stop: when the fleet 50-game mean reward
+    # (stat mailbox, window full) reaches this value the learner exits
+    # cleanly (exit code 0) before max_updates. None = run the full budget.
+    stop_at_reward: float | None = None
 
     # logging / checkpoints
     loss_log_interval: int = 50
@@ -243,6 +257,15 @@ class Config:
                 "has a continuous action space; use PPO-Continuous or "
                 "SAC-Continuous"
             )
+        if self.entropy_anneal is not None:
+            a = self.entropy_anneal
+            assert "coef" in a, "entropy_anneal needs 'coef' (final entropy_coef)"
+            assert ("at" in a) or ("frac" in a), (
+                "entropy_anneal needs a switch point: 'at' (absolute update "
+                "index) or 'frac' (fraction of the run's update budget)"
+            )
+            if "frac" in a:
+                assert 0.0 < float(a["frac"]) < 1.0, a["frac"]
 
     @property
     def effective_act_ctx(self) -> int:

@@ -83,7 +83,8 @@ class LearnerService:
             from tpu_rl.parallel import make_sp_mesh
 
             mesh = make_sp_mesh(cfg.mesh_data, cfg.mesh_seq)
-        family, state, train_step = get_algo(cfg.algo).build(
+        spec = get_algo(cfg.algo)
+        family, state, train_step = spec.build(
             cfg, jax.random.key(self.seed), mesh=mesh
         )
 
@@ -98,14 +99,19 @@ class LearnerService:
                 print(f"[learner] resumed from checkpoint idx {start_idx}")
 
         # ---- compile: single-chip jit, data-parallel, or data x seq mesh ----
+        # _wrap is reused by the entropy-anneal switch below, which rebuilds
+        # the raw train step with the post-switch cfg and must re-apply the
+        # same mesh/jit wrapping.
         self._place_global = None
-        if mesh is not None:
+        if mesh is not None and cfg.mesh_seq > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from tpu_rl.parallel.dp import make_sp_train_step, replicate
             from tpu_rl.parallel.sequence import DATA_AXIS, SEQ_AXIS
 
-            train_step = make_sp_train_step(train_step, mesh, cfg)
+            def _wrap(step, wcfg):
+                return make_sp_train_step(step, mesh, wcfg)
+
             state = replicate(state, mesh)
             self._setup_multihost_feed(
                 NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
@@ -115,11 +121,33 @@ class LearnerService:
             from tpu_rl.parallel.mesh import batch_sharding, make_mesh
 
             mesh = make_mesh(cfg.mesh_data)
-            train_step = make_parallel_train_step(train_step, mesh, cfg)
+
+            def _wrap(step, wcfg):
+                return make_parallel_train_step(step, mesh, wcfg)
+
             state = replicate(state, mesh)
             self._setup_multihost_feed(batch_sharding(mesh))
         else:
-            train_step = jax.jit(train_step, donate_argnums=(0,))
+
+            def _wrap(step, wcfg):
+                return jax.jit(step, donate_argnums=(0,))
+
+        train_step = _wrap(train_step, cfg)
+
+        # Two-phase entropy/lr anneal switch point (Config.entropy_anneal;
+        # same semantics as the inline harness, examples/train_inline.py).
+        anneal = cfg.entropy_anneal
+        anneal_at = None
+        if anneal is not None:
+            if "at" in anneal:
+                anneal_at = int(anneal["at"])
+            elif self.max_updates is not None:
+                anneal_at = int(float(anneal["frac"]) * self.max_updates)
+            else:
+                print(
+                    "[learner] entropy_anneal uses 'frac' but the run has no "
+                    "max_updates budget; anneal disabled", flush=True,
+                )
 
         pub = Pub("*", self.model_port, bind=True, hwm=MODEL_HWM)
         writer = make_writer(cfg.result_dir)
@@ -157,6 +185,24 @@ class LearnerService:
                         state, metrics = train_step(state, batch, sub_key)
                 idx += 1
 
+                if anneal_at is not None and idx - start_idx == anneal_at:
+                    # Rebuild the step with the cold-phase coefficients (one
+                    # extra jit compile; optimizer state carries over — the
+                    # on-policy families use rmsprop, whose accumulator is
+                    # lr-independent). std_floor/family changes are NOT
+                    # supported here: workers build their own family from the
+                    # original cfg and cannot re-floor mid-run.
+                    cfg = cfg.replace(
+                        entropy_coef=float(anneal["coef"]),
+                        lr=float(anneal.get("lr", cfg.lr)),
+                    )
+                    self.cfg = cfg
+                    train_step = _wrap(spec.make_train_step(cfg, family), cfg)
+                    print(
+                        f"[learner] update {idx}: entropy_coef -> "
+                        f"{cfg.entropy_coef}, lr -> {cfg.lr}", flush=True,
+                    )
+
                 if cfg.profile_dir is not None:
                     # Window is relative to THIS run's updates (resume-safe).
                     rel = idx - start_idx
@@ -179,6 +225,21 @@ class LearnerService:
                     ckpt.save(state, idx)
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
+                sa = self.stat_array
+                if (
+                    cfg.stop_at_reward is not None
+                    and sa is not None
+                    and sa[0] >= 50  # stat window full: a real 50-game mean
+                    and sa[1] >= cfg.stop_at_reward
+                ):
+                    logger.log_stat(int(sa[0]), float(sa[1]))
+                    logger.flush()
+                    print(
+                        f"[learner] fleet 50-game mean {sa[1]:.1f} >= "
+                        f"stop_at_reward {cfg.stop_at_reward}: solved, "
+                        f"stopping at update {idx}", flush=True,
+                    )
+                    break
         finally:
             if profiling:
                 # Never leave a trace open (early exit / stop-event / crash).
