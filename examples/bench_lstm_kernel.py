@@ -26,7 +26,7 @@ import numpy as np
 
 from tpu_rl.models import cells
 from tpu_rl.models.cells import LSTMCell
-from tpu_rl.ops.pallas_lstm import batch_tile
+from tpu_rl.ops.pallas_lstm import batch_tile, bwd_batch_tile
 
 SHAPES = [
     # (B, S, IN, H, iters) — reference quantum, mid, wide (grid-tiled)
@@ -72,8 +72,19 @@ def main() -> None:
         carry0 = (jnp.zeros((B, H)), jnp.zeros((B, H)))
         params = cell.init(jax.random.key(0), (carry0[0], carry0[1]), x[:, 0])
         for grad in (False, True):
+            # "force" runs the REAL kernel wherever a tiling fits (auto now
+            # dispatches by measured win, so auto's fwd-only path is the
+            # scan — forcing is the only way to keep timing the kernel).
             t_scan = _run(cell, params, x, firsts, carry0, "off", grad, iters)
-            t_kern = _run(cell, params, x, firsts, carry0, "auto", grad, iters)
+            t_kern = _run(cell, params, x, firsts, carry0, "force", grad, iters)
+            # What auto-dispatch picks at this (shape, pass): the kernel only
+            # under AD at whole-batch-single-tile shapes (cells._use_pallas +
+            # the lstm_unroll primal's scan body).
+            single_tile = (
+                batch_tile(B, S, H) == B and bwd_batch_tile(B, S, H) == B
+            )
+            chosen = "kernel" if (grad and single_tile) else "scan"
+            chosen_ms = t_kern if chosen == "kernel" else t_scan
             row = {
                 "shape": f"B{B} S{S} H{H}",
                 "pass": "fwd+grad" if grad else "fwd",
@@ -82,6 +93,10 @@ def main() -> None:
                 "kernel_ms": round(t_kern * 1e3, 3),
                 "speedup": round(t_scan / t_kern, 2),
                 "tokens_per_s_kernel": round(B * S / t_kern, 1),
+                "auto_chooses": chosen,
+                "auto_regression": round(
+                    chosen_ms / min(t_scan, t_kern), 3
+                ),  # 1.0 = auto picked the measured-fastest path
             }
             rows.append(row)
             print(json.dumps(row), flush=True)

@@ -54,12 +54,20 @@ def measure(num_envs: int, seconds: float, base_port: int) -> dict:
     t = threading.Thread(target=w.run, daemon=True)
     t.start()
 
-    n_msgs = 0
-    # warmup (jit compile + zmq join), then timed window
-    deadline = time.time() + 3.0
-    while time.time() < deadline:
-        if relay.recv(timeout_ms=100) is not None:
-            n_msgs += 1
+    # Warmup gates on RECEIVED TRAFFIC, not wall-clock: wait for the first
+    # Rollout message (jit compile + ZMQ slow-join complete), then drain a
+    # short settle window. A fixed sleep understates throughput whenever
+    # compile bleeds into the timed region on a slow/loaded host.
+    warmup_deadline = time.time() + 120.0
+    while time.time() < warmup_deadline:
+        got = relay.recv(timeout_ms=100)
+        if got is not None and got[0] == Protocol.Rollout:
+            break
+    else:
+        raise RuntimeError("worker produced no Rollout within 120 s warmup")
+    settle = time.time() + 1.0
+    while time.time() < settle:
+        relay.recv(timeout_ms=50)
     n_msgs = 0
     t0 = time.time()
     deadline = t0 + seconds

@@ -100,7 +100,16 @@ def run(
     spec = get_algo(cfg.algo)
     family, state, train_step = spec.build(cfg, jax.random.key(seed))
     train_step = jax.jit(train_step)
-    switch_at = int(anneal["frac"] * updates) if anneal else None
+    switch_at = None
+    if anneal:
+        # "at" absolute / "frac" relative — same contract as the cluster
+        # learner (Config.entropy_anneal); inline runs have no resume, so
+        # absolute and relative coincide here.
+        switch_at = max(
+            1,
+            int(anneal["at"]) if "at" in anneal
+            else int(anneal["frac"] * updates),
+        )
     act = jax.jit(family.act)
 
     env = EnvAdapter(cfg, seed=seed)
@@ -141,6 +150,14 @@ def run(
             if env_steps < warmup_steps:
                 # keep the policy carry (h2, c2) consistent with what the
                 # policy *saw*, but override the executed/stored action.
+                # The stored log_prob/logits then describe the POLICY'S
+                # sampled action, not the executed one — poison them with NaN
+                # so any future consumer fails loudly instead of silently
+                # importance-weighting with garbage (warmup is gated to SAC,
+                # which recomputes log-probs from the current policy and
+                # never reads these fields).
+                log_prob = jnp.full_like(log_prob, jnp.nan)
+                logits = jnp.full_like(logits, jnp.nan)
                 if family.continuous:
                     if rng.random() < warmup_flip_p:
                         warm_sign = -warm_sign

@@ -101,12 +101,14 @@ class Config:
     # Two-phase entropy/lr anneal, applied by both the inline harness and the
     # distributed learner (LearnerService): after a switch point the run
     # continues with {"coef": final_entropy_coef, "lr": final_lr (optional)}.
-    # The switch point is {"at": update_index} absolute, or {"frac": f} as a
-    # fraction of the run's update budget (inline: the updates arg; cluster:
-    # max_updates). High early exploration, then a near-deterministic
-    # low-variance tail — capped-return targets (CartPole 500) need it
-    # (measured: a fixed entropy bonus that keeps entropy ~0.58 caps the
-    # 50-game mean near 50; see BASELINE_RESULTS.md / CLUSTER_LEARNING.md).
+    # The switch point is {"at": n} — an ABSOLUTE update index, so a
+    # checkpoint-resumed learner already past it re-enters the cold phase
+    # immediately — or {"frac": f} as a fraction of the run's update budget
+    # (inline: the updates arg; cluster: max_updates). High early
+    # exploration, then a near-deterministic low-variance tail —
+    # capped-return targets (CartPole 500) need it (measured: a fixed
+    # entropy bonus that keeps entropy ~0.58 caps the 50-game mean near 50;
+    # see BASELINE_RESULTS.md / CLUSTER_LEARNING.md).
     entropy_anneal: dict | None = None
     # Distributed learner early stop: when the fleet 50-game mean reward
     # (stat mailbox, window full) reaches this value the learner exits

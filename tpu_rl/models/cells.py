@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 Carry = tuple[jax.Array, jax.Array]
 
-_PALLAS_MODE = "auto"  # "auto" | "interpret" | "off"
+_PALLAS_MODE = "auto"  # "auto" | "interpret" | "off" | "force"
 # Data-parallel mesh registered by make_parallel_train_step: when set, the
 # Pallas kernel runs as a shard_map island over the mesh's "data" axis (each
 # device unrolls its local batch shard) instead of being disabled under GSPMD
@@ -33,7 +33,12 @@ _DATA_MESH = None
 
 
 def set_pallas_mode(mode: str) -> None:
-    assert mode in ("auto", "interpret", "off"), mode
+    """"auto": measured-win dispatch (kernel only where it beats the scan);
+    "off": always scan; "interpret": kernel in interpreter mode (CPU tests);
+    "force": real kernel wherever it FITS, ignoring the measured-win gate —
+    benchmarking only (bench_lstm_kernel.py times the raw kernel against the
+    scan to re-derive the gate)."""
+    assert mode in ("auto", "interpret", "off", "force"), mode
     global _PALLAS_MODE
     _PALLAS_MODE = mode
 
@@ -53,7 +58,7 @@ def _use_pallas(
     ``mesh_active`` says THIS trace will wrap the kernel in shard_map (a
     registered-but-unusable mesh, e.g. a non-divisible init trace, must NOT
     count: an unwrapped Mosaic call cannot live in a multi-device program)."""
-    from tpu_rl.ops.pallas_lstm import batch_tile
+    from tpu_rl.ops.pallas_lstm import batch_tile, bwd_batch_tile
 
     if _PALLAS_MODE == "off":
         return False, False
@@ -62,8 +67,23 @@ def _use_pallas(
         # interpreter has no VMEM), so equivalence tests can never silently
         # degrade into scan-vs-scan.
         return True, True
-    if batch_tile(batch, seq, hidden) is None:
-        # No batch tiling can fit VMEM (very long seq x wide hidden).
+    if _PALLAS_MODE == "force":
+        # Benchmark override: real kernel wherever a tiling fits.
+        if batch_tile(batch, seq, hidden) is None:
+            return False, False
+        if jax.default_backend() != "tpu":
+            return False, False
+        return len(jax.devices()) == 1 or mesh_active, False
+    if (
+        batch_tile(batch, seq, hidden) != batch
+        or bwd_batch_tile(batch, seq, hidden) != batch
+    ):
+        # Measured-win gate (bench_lstm_kernel.json): the fused kernel beats
+        # the scan only when the WHOLE batch is one VMEM tile for both passes
+        # (fwd+grad 1.75x at B128/H64, 1.56x at B256/H256). Multi-tile grids
+        # starve the MXU (fwd 0.82x, fwd+grad 1.0x at B1024/H1024) and
+        # no-tile-fits shapes can't run at all — both keep the scan, whose
+        # per-step matmuls always see the full batch.
         return False, False
     if jax.default_backend() != "tpu":
         return False, False
@@ -128,7 +148,7 @@ class LSTMCell(nn.Module):
 
         mesh = _DATA_MESH
         n_data = 1
-        if mesh is not None and _PALLAS_MODE in ("auto", "interpret"):
+        if mesh is not None and _PALLAS_MODE in ("auto", "interpret", "force"):
             from tpu_rl.parallel.mesh import DATA_AXIS
 
             n_data = mesh.shape.get(DATA_AXIS, 1)
@@ -169,21 +189,15 @@ class LSTMCell(nn.Module):
                 hs, cs = lstm_unroll(*args, interpret)
             return (hs[:, -1], cs[:, -1]), hs
 
-        def step(carry, xs):
-            h, c = carry
-            xp_t, keep_t = xs
-            h = h * keep_t[:, None]
-            c = c * keep_t[:, None]
-            z = xp_t + h @ self.recurrent_kernel
-            h2, c2 = self._gates(z, c)
-            return (h2, c2), h2
+        # Scan fallback shares ONE implementation of the step math with the
+        # custom_vjp primal (pallas_lstm._scan_forward), so the auto-mode
+        # non-AD path and the "off" path can never diverge bit-wise.
+        from tpu_rl.ops.pallas_lstm import _scan_forward
 
-        carry, hs = jax.lax.scan(
-            step,
-            carry0,
-            (jnp.moveaxis(xp, 1, 0), jnp.moveaxis(keep, 1, 0)),
+        hs, cs = _scan_forward(
+            xp, self.recurrent_kernel, carry0[0], carry0[1], keep
         )
-        return carry, jnp.moveaxis(hs, 0, 1)
+        return (hs[:, -1], cs[:, -1]), hs
 
     @staticmethod
     def zero_carry(hidden: int, batch_shape: tuple[int, ...] = ()) -> Carry:

@@ -190,14 +190,57 @@ def bwd_batch_tile(batch: int, seq: int, hidden: int) -> int | None:
     return _best_tile(batch, fits)
 
 
+def _scan_forward(xp, wh, h0, c0, keep):
+    """Plain ``lax.scan`` forward over the precomputed input projection —
+    the measured winner for UNdifferentiated unrolls (the fused kernel is
+    0.82-0.99x the scan on forward-only at every benched shape,
+    bench_lstm_kernel.json; it wins only when the fused backward is in
+    play)."""
+
+    def step(carry, xs):
+        h, c = carry
+        xp_t, keep_t = xs
+        kp = keep_t[:, None]
+        h = h * kp
+        c = c * kp
+        z = xp_t + jnp.dot(h, wh, preferred_element_type=jnp.float32)
+        H = wh.shape[0]
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H : 2 * H])
+        g = jnp.tanh(z[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(z[:, 3 * H :])
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), (h2, c2)
+
+    _, (hs, cs) = jax.lax.scan(
+        step, (h0, c0), (jnp.moveaxis(xp, 1, 0), jnp.moveaxis(keep, 1, 0))
+    )
+    return jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def lstm_unroll(xp, wh, h0, c0, keep, interpret=False):
     """Fused LSTM over a sequence.
 
     xp (B,S,4H) input projection incl. bias; wh (H,4H); h0/c0 (B,H);
-    keep (B,S) carry-keep mask. Returns (hs, cs), each (B,S,H)."""
-    hs, cs = _pallas_forward(xp, wh, h0, c0, keep, interpret, save_acts=False)
-    return hs, cs
+    keep (B,S) carry-keep mask. Returns (hs, cs), each (B,S,H).
+
+    Measured-win dispatch (bench_lstm_kernel.json): this primal body runs
+    only when the call is NOT differentiated (custom_vjp routes traced-for-AD
+    calls through ``_fwd``), and forward-only is where the kernel loses
+    (0.82-0.99x the scan at every shape) — so the undifferentiated path
+    always scans. ``interpret`` (CPU equivalence tests) and the cells
+    module's "force" benchmark mode still run the kernel so tests and the
+    gate-deriving benchmark can never silently degrade into scan-vs-scan."""
+    from tpu_rl.models.cells import _PALLAS_MODE
+
+    if interpret or _PALLAS_MODE == "force":
+        hs, cs = _pallas_forward(
+            xp, wh, h0, c0, keep, interpret, save_acts=False
+        )
+        return hs, cs
+    return _scan_forward(xp, wh, h0, c0, keep)
 
 
 def _fwd(xp, wh, h0, c0, keep, interpret):
@@ -316,10 +359,18 @@ def _bwd(interpret, res, ct):
     # while at grid 1 the fusion wins (1.2x at the reference quantum). Wide
     # multi-tile shapes keep the scan backward, whose per-step matmuls see
     # the full batch. (lstm_unroll is only reached when the cell chose the
-    # kernel for the forward.)
+    # kernel for the forward.) The cells "force" benchmark mode overrides
+    # this gate too (any fitting tile), so force-mode fwd+grad rows time the
+    # genuinely fused kernel pair, not kernel-fwd + scan-bwd.
+    from tpu_rl.models.cells import _PALLAS_MODE
+
+    bwd_tile = bwd_batch_tile(B, S, H)
     if interpret or (
         jax.default_backend() == "tpu"
-        and bwd_batch_tile(B, S, H) == B
+        and (
+            bwd_tile == B
+            or (_PALLAS_MODE == "force" and bwd_tile is not None)
+        )
     ):
         dxp, dh0, dc0 = _pallas_backward(
             wh, h0, c0, keep, hs, cs, acts, dhs, dcs, interpret

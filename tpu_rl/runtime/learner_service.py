@@ -28,6 +28,7 @@ import numpy as np
 from tpu_rl.config import Config, is_off_policy
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import ShmHandles, make_store
+from tpu_rl.runtime.manager import STAT_WINDOW
 from tpu_rl.runtime.protocol import Protocol
 from tpu_rl.runtime.transport import MODEL_HWM, Pub
 from tpu_rl.utils.metrics import LearnerLogger, make_writer
@@ -103,7 +104,7 @@ class LearnerService:
         # the raw train step with the post-switch cfg and must re-apply the
         # same mesh/jit wrapping.
         self._place_global = None
-        if mesh is not None and cfg.mesh_seq > 1:
+        if mesh is not None:  # built above iff cfg.mesh_seq > 1
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from tpu_rl.parallel.dp import make_sp_train_step, replicate
@@ -136,13 +137,19 @@ class LearnerService:
 
         # Two-phase entropy/lr anneal switch point (Config.entropy_anneal;
         # same semantics as the inline harness, examples/train_inline.py).
+        # "at" is an ABSOLUTE update index — checked with >= against the
+        # global counter, so a run resumed past the switch re-enters the
+        # cold phase on its first update instead of undoing the anneal.
+        # "frac" is relative to THIS run's max_updates budget.
         anneal = cfg.entropy_anneal
         anneal_at = None
+        anneal_absolute = False
         if anneal is not None:
             if "at" in anneal:
-                anneal_at = int(anneal["at"])
+                anneal_at = max(1, int(anneal["at"]))
+                anneal_absolute = True
             elif self.max_updates is not None:
-                anneal_at = int(float(anneal["frac"]) * self.max_updates)
+                anneal_at = max(1, int(float(anneal["frac"]) * self.max_updates))
             else:
                 print(
                     "[learner] entropy_anneal uses 'frac' but the run has no "
@@ -185,7 +192,8 @@ class LearnerService:
                         state, metrics = train_step(state, batch, sub_key)
                 idx += 1
 
-                if anneal_at is not None and idx - start_idx == anneal_at:
+                progress = idx if anneal_absolute else idx - start_idx
+                if anneal_at is not None and progress >= anneal_at:
                     # Rebuild the step with the cold-phase coefficients (one
                     # extra jit compile; optimizer state carries over — the
                     # on-policy families use rmsprop, whose accumulator is
@@ -198,6 +206,7 @@ class LearnerService:
                     )
                     self.cfg = cfg
                     train_step = _wrap(spec.make_train_step(cfg, family), cfg)
+                    anneal_at = None  # fire once
                     print(
                         f"[learner] update {idx}: entropy_coef -> "
                         f"{cfg.entropy_coef}, lr -> {cfg.lr}", flush=True,
@@ -229,7 +238,9 @@ class LearnerService:
                 if (
                     cfg.stop_at_reward is not None
                     and sa is not None
-                    and sa[0] >= 50  # stat window full: a real 50-game mean
+                    # window full: a real STAT_WINDOW-game mean, not a
+                    # lucky few-episode start
+                    and sa[0] >= STAT_WINDOW
                     and sa[1] >= cfg.stop_at_reward
                 ):
                     logger.log_stat(int(sa[0]), float(sa[1]))
