@@ -188,6 +188,18 @@ WORKLOADS: list[tuple[str, dict, int, int]] = [
         ),
         5, 30,
     ),
+    # Same workload with bf16 matmul compute (params f32, f32 accumulation;
+    # models/cells.py): the dtype-matched chip-capability row — its MFU is
+    # against the SAME bf16 peak the denominator uses, unlike the f32 row
+    # above, whose MFU vs bf16 peak understates by construction.
+    (
+        "IMPALA@wide-lstm-bf16",
+        dict(
+            algo="IMPALA", batch_size=1024, seq_len=16, hidden_size=1024,
+            obs_shape=(64,), action_space=8, compute_dtype="bfloat16",
+        ),
+        5, 30,
+    ),
     (
         "PPO-transformer@longctx",
         dict(

@@ -18,12 +18,15 @@ def test_continuous_algos_accept_continuous_env():
         )
 
 
-def test_bf16_requires_transformer():
-    with pytest.raises(AssertionError, match="bfloat16"):
-        Config.from_dict({"compute_dtype": "bfloat16", "model": "lstm"})
+def test_bf16_both_backbones():
+    """bfloat16 compute is wired for BOTH backbones (transformer via flax
+    module dtype; LSTM families via LSTMCell mixed precision)."""
+    Config.from_dict({"compute_dtype": "bfloat16", "model": "lstm"})
     Config.from_dict(
         {"compute_dtype": "bfloat16", "model": "transformer", "algo": "PPO"}
     )
+    with pytest.raises(AssertionError, match="compute_dtype"):
+        Config.from_dict({"compute_dtype": "float16"})
 
 
 def test_sequence_parallel_constraints():

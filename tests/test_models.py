@@ -149,3 +149,44 @@ def test_continuous_greedy_act(algo):
     assert h2.shape == (fam.hidden,) and c2.shape == (fam.hidden,)
     np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
     assert np.all(np.abs(np.asarray(a1)) <= 1.0)
+
+
+def test_bf16_lstm_mixed_precision():
+    """compute_dtype='bfloat16' on the LSTM families: params stay f32,
+    outputs stay f32, and the forward tracks the f32 forward to bf16
+    tolerance (the matmuls run in bf16 with f32 accumulation; gates, carry,
+    and heads are f32 — models/cells.py). A train step stays finite."""
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.types import Batch
+
+    cfg32 = small_config(algo="IMPALA", hidden_size=32)
+    cfg16 = cfg32.replace(compute_dtype="bfloat16")
+    fam32, fam16 = build_family(cfg32), build_family(cfg16)
+    params = fam32.init_params(jax.random.PRNGKey(0), seq_len=cfg32.seq_len)
+    # One parameter tree serves both: bf16 is a compute property, not a
+    # storage property, so checkpoints are dtype-portable.
+    leaves = jax.tree_util.tree_leaves(params)
+    assert all(l.dtype == jnp.float32 for l in leaves)
+
+    obs, carry0, firsts = _batch_inputs(fam32, B=4, S=5)
+    lo32, v32, _ = fam32.actor_unroll(params["actor"], obs, carry0, firsts)
+    lo16, v16, _ = fam16.actor_unroll(params["actor"], obs, carry0, firsts)
+    assert lo16.dtype == jnp.float32 and v16.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(lo16), np.asarray(lo32), atol=0.05, rtol=0.05
+    )
+
+    family, state, train_step = get_algo("IMPALA").build(
+        cfg16, jax.random.PRNGKey(0)
+    )
+    zb = Batch.zeros(
+        cfg16.batch_size, cfg16.seq_len, cfg16.obs_shape, cfg16.action_space,
+        cfg16.hidden_size,
+    )
+    batch = zb.replace(
+        obs=jax.random.normal(jax.random.PRNGKey(2), zb.obs.shape),
+        log_prob=jnp.full(zb.log_prob.shape, -0.69),
+    )
+    state, metrics = jax.jit(train_step)(state, batch, jax.random.PRNGKey(3))
+    for k, v in metrics.items():
+        assert np.isfinite(np.asarray(v)).all(), (k, v)

@@ -215,13 +215,10 @@ class Config:
             "bfloat16",
         ), f"compute_dtype must be float32 or bfloat16, got {self.compute_dtype!r}"
         assert self.model in ("lstm", "transformer"), self.model
-        if self.compute_dtype == "bfloat16":
-            # Only the transformer path is bf16-wired today; reject instead
-            # of silently running the LSTM families in float32.
-            assert self.model == "transformer", (
-                "compute_dtype='bfloat16' currently requires "
-                "model='transformer' (LSTM families run float32)"
-            )
+        # bfloat16 is wired for both backbones: the transformer via flax
+        # module dtype (transformer.py), the LSTM families via
+        # LSTMCell.dtype mixed precision (params f32, matmul compute bf16,
+        # carry/gates/heads f32 — models/cells.py).
         assert self.attention_impl in ("full", "blockwise", "ring", "ulysses")
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs

@@ -102,13 +102,29 @@ class LSTMCell(nn.Module):
     """
 
     hidden: int
+    # Matmul compute dtype (params stay float32): jnp.bfloat16 runs the
+    # input projection and the recurrent matmul at MXU bf16 rate with f32
+    # accumulation; gates, carry, and outputs stay float32. None = float32.
+    # The fused Pallas kernel is f32-only — bf16 compute always takes the
+    # scan path (the MXU-loading wide shapes are multi-tile, where the scan
+    # is the measured winner anyway; see _use_pallas).
+    dtype: jnp.dtype | None = None
 
     def setup(self):
-        self.x_proj = nn.Dense(4 * self.hidden, name="x_proj")
+        self.x_proj = nn.Dense(4 * self.hidden, name="x_proj", dtype=self.dtype)
         self.recurrent_kernel = self.param(
             "recurrent_kernel",
             nn.initializers.lecun_normal(),
             (self.hidden, 4 * self.hidden),
+        )
+
+    def _rec_matmul(self, h: jax.Array) -> jax.Array:
+        if self.dtype is None:
+            return h @ self.recurrent_kernel
+        return jnp.dot(
+            h.astype(self.dtype),
+            self.recurrent_kernel.astype(self.dtype),
+            preferred_element_type=jnp.float32,
         )
 
     def _gates(self, z: jax.Array, c: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -125,7 +141,7 @@ class LSTMCell(nn.Module):
 
     def __call__(self, carry: Carry, x: jax.Array) -> tuple[Carry, jax.Array]:
         h, c = carry
-        z = self.x_proj(x) + h @ self.recurrent_kernel
+        z = self.x_proj(x).astype(jnp.float32) + self._rec_matmul(h)
         h2, c2 = self._gates(z, c)
         return (h2, c2), h2
 
@@ -157,6 +173,13 @@ class LSTMCell(nn.Module):
         use_kernel, interpret = _use_pallas(
             B // n_data, S, self.hidden, mesh_active=mesh is not None and n_data > 1
         )
+        if self.dtype is not None and _PALLAS_MODE != "interpret":
+            # bf16 compute: the f32-only fused kernel would first cast its
+            # operands up, forfeiting the MXU-rate win that motivated bf16 —
+            # the mixed-precision scan is the right path. (interpret mode
+            # still exercises the kernel for equivalence tests; it casts to
+            # f32 explicitly below.)
+            use_kernel = False
         if use_kernel:
             from tpu_rl.ops.pallas_lstm import lstm_unroll
 
@@ -195,7 +218,8 @@ class LSTMCell(nn.Module):
         from tpu_rl.ops.pallas_lstm import _scan_forward
 
         hs, cs = _scan_forward(
-            xp, self.recurrent_kernel, carry0[0], carry0[1], keep
+            xp, self.recurrent_kernel, carry0[0], carry0[1], keep,
+            matmul_dtype=self.dtype,
         )
         return (hs[:, -1], cs[:, -1]), hs
 

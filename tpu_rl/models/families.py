@@ -209,7 +209,14 @@ def build_family(cfg: Config, mesh=None) -> ModelFamily:
     transformer training (attention_impl ring/ulysses)."""
     obs_dim = int(cfg.obs_shape[0])
     n = int(cfg.action_space)
-    kw = dict(hidden=cfg.hidden_size, reset_on_first=cfg.reset_carry_on_first)
+    kw = dict(
+        hidden=cfg.hidden_size,
+        reset_on_first=cfg.reset_carry_on_first,
+        # Mixed precision for the LSTM families: params f32, torso/LSTM
+        # matmuls at MXU bf16 rate with f32 accumulation (heads and the
+        # recurrent carry stay f32 — see LSTMCell.dtype).
+        dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None,
+    )
 
     if cfg.model == "transformer":
         from tpu_rl.models.transformer import TransformerActorCritic

@@ -53,10 +53,11 @@ class DiscreteActorCritic(nn.Module):
     n_actions: int
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None  # matmul compute dtype; params stay f32
 
     def setup(self):
-        self.body = nn.Dense(self.hidden, name="body")
-        self.cell = LSTMCell(self.hidden, name="cell")
+        self.body = nn.Dense(self.hidden, name="body", dtype=self.dtype)
+        self.cell = LSTMCell(self.hidden, name="cell", dtype=self.dtype)
         self.logits_head = nn.Dense(self.n_actions, name="logits")
         self.value_head = nn.Dense(1, name="value")
 
@@ -94,10 +95,11 @@ class ContinuousActorCritic(nn.Module):
     hidden: int = 64
     reset_on_first: bool = True
     std_floor: float = 0.0
+    dtype: jnp.dtype | None = None  # matmul compute dtype; params stay f32
 
     def setup(self):
-        self.body = nn.Dense(self.hidden, name="body")
-        self.cell = LSTMCell(self.hidden, name="cell")
+        self.body = nn.Dense(self.hidden, name="body", dtype=self.dtype)
+        self.cell = LSTMCell(self.hidden, name="cell", dtype=self.dtype)
         self.mu_head = nn.Dense(self.n_actions, name="mu")
         self.std_head = nn.Dense(self.n_actions, name="std")
         self.value_head = nn.Dense(1, name="value")
@@ -130,10 +132,11 @@ class SACDiscreteActor(nn.Module):
     n_actions: int
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None  # matmul compute dtype; params stay f32
 
     def setup(self):
-        self.body = nn.Dense(self.hidden, name="body")
-        self.cell = LSTMCell(self.hidden, name="cell")
+        self.body = nn.Dense(self.hidden, name="body", dtype=self.dtype)
+        self.cell = LSTMCell(self.hidden, name="cell", dtype=self.dtype)
         self.logits_head = nn.Dense(self.n_actions, name="logits")
 
     def act(self, obs: jax.Array, carry: Carry):
@@ -156,10 +159,11 @@ class SACDiscreteCritic(nn.Module):
     n_actions: int
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None  # matmul compute dtype; params stay f32
 
     def setup(self):
-        self.body = nn.Dense(self.hidden, name="body")
-        self.cell = LSTMCell(self.hidden, name="cell")
+        self.body = nn.Dense(self.hidden, name="body", dtype=self.dtype)
+        self.cell = LSTMCell(self.hidden, name="cell", dtype=self.dtype)
         self.q_head = nn.Dense(self.n_actions, name="q")
 
     def __call__(self, obs: jax.Array, carry0: Carry, firsts: jax.Array):
@@ -175,12 +179,14 @@ class SACDiscreteTwinCritic(nn.Module):
     n_actions: int
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None
 
     def setup(self):
         kw = dict(
             n_actions=self.n_actions,
             hidden=self.hidden,
             reset_on_first=self.reset_on_first,
+            dtype=self.dtype,
         )
         self.q1 = SACDiscreteCritic(name="q1", **kw)
         self.q2 = SACDiscreteCritic(name="q2", **kw)
@@ -198,10 +204,11 @@ class SACContinuousActor(nn.Module):
     n_actions: int
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None  # matmul compute dtype; params stay f32
 
     def setup(self):
-        self.body = nn.Dense(self.hidden, name="body")
-        self.cell = LSTMCell(self.hidden, name="cell")
+        self.body = nn.Dense(self.hidden, name="body", dtype=self.dtype)
+        self.cell = LSTMCell(self.hidden, name="cell", dtype=self.dtype)
         self.mu_head = nn.Dense(self.n_actions, name="mu")
         self.log_std_head = nn.Dense(self.n_actions, name="log_std")
 
@@ -231,12 +238,13 @@ class SACContinuousCritic(nn.Module):
 
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None  # matmul compute dtype; params stay f32
 
     def setup(self):
         half = self.hidden // 2
-        self.obs_body = nn.Dense(half, name="obs_body")
-        self.act_body = nn.Dense(half, name="act_body")
-        self.cell = LSTMCell(self.hidden, name="cell")
+        self.obs_body = nn.Dense(half, name="obs_body", dtype=self.dtype)
+        self.act_body = nn.Dense(half, name="act_body", dtype=self.dtype)
+        self.cell = LSTMCell(self.hidden, name="cell", dtype=self.dtype)
         self.q_head = nn.Dense(1, name="q")
 
     def __call__(
@@ -255,9 +263,14 @@ class SACContinuousTwinCritic(nn.Module):
 
     hidden: int = 64
     reset_on_first: bool = True
+    dtype: jnp.dtype | None = None
 
     def setup(self):
-        kw = dict(hidden=self.hidden, reset_on_first=self.reset_on_first)
+        kw = dict(
+            hidden=self.hidden,
+            reset_on_first=self.reset_on_first,
+            dtype=self.dtype,
+        )
         self.q1 = SACContinuousCritic(name="q1", **kw)
         self.q2 = SACContinuousCritic(name="q2", **kw)
 

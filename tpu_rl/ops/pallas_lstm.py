@@ -190,12 +190,18 @@ def bwd_batch_tile(batch: int, seq: int, hidden: int) -> int | None:
     return _best_tile(batch, fits)
 
 
-def _scan_forward(xp, wh, h0, c0, keep):
+def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None):
     """Plain ``lax.scan`` forward over the precomputed input projection —
     the measured winner for UNdifferentiated unrolls (the fused kernel is
     0.82-0.99x the scan on forward-only at every benched shape,
     bench_lstm_kernel.json; it wins only when the fused backward is in
-    play)."""
+    play).
+
+    ``matmul_dtype`` (e.g. ``jnp.bfloat16``) casts ONLY the recurrent
+    matmul operands — MXU-rate compute with f32 accumulation
+    (``preferred_element_type``); the carry, gate math, and outputs stay
+    float32. None = pure float32 (bit-identical to the fused kernel)."""
+    wh_m = wh if matmul_dtype is None else wh.astype(matmul_dtype)
 
     def step(carry, xs):
         h, c = carry
@@ -203,7 +209,10 @@ def _scan_forward(xp, wh, h0, c0, keep):
         kp = keep_t[:, None]
         h = h * kp
         c = c * kp
-        z = xp_t + jnp.dot(h, wh, preferred_element_type=jnp.float32)
+        hm = h if matmul_dtype is None else h.astype(matmul_dtype)
+        z = xp_t.astype(jnp.float32) + jnp.dot(
+            hm, wh_m, preferred_element_type=jnp.float32
+        )
         H = wh.shape[0]
         i = jax.nn.sigmoid(z[:, :H])
         f = jax.nn.sigmoid(z[:, H : 2 * H])
