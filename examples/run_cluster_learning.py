@@ -51,11 +51,24 @@ def main() -> None:
     p.add_argument("--anneal-coef", type=float, default=5e-5)
     p.add_argument("--anneal-lr", type=float, default=1e-4)
     p.add_argument("--anneal-frac", type=float, default=0.4)
+    p.add_argument(
+        "--anneal-at", type=int, default=None,
+        help="absolute switch update (overrides --anneal-frac); with "
+        "--resume-from past this index the cold phase resumes immediately",
+    )
     p.add_argument("--no-anneal", action="store_true")
     p.add_argument("--worker-step-sleep", type=float, default=0.02)
     p.add_argument("--target", type=float, default=475.0,
                    help="stop early when the fleet 50-game mean reaches this")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--resume-from", default=None,
+        help="models dir of a previous run: the learner restores the newest "
+        "checkpoint (params + optimizer + update counter) and the workers "
+        "warm-start from it — the SURVEY §5.4 resume path, exercised on the "
+        "real topology. With an absolute anneal switch ('at') already "
+        "passed, the resumed learner re-enters the cold phase immediately.",
+    )
     args = p.parse_args()
 
     from tpu_rl.config import Config, MachinesConfig, WorkerMachine
@@ -82,7 +95,11 @@ def main() -> None:
                 else {
                     "coef": args.anneal_coef,
                     "lr": args.anneal_lr,
-                    "frac": args.anneal_frac,
+                    **(
+                        {"at": args.anneal_at}
+                        if args.anneal_at is not None
+                        else {"frac": args.anneal_frac}
+                    ),
                 }
             ),
             stop_at_reward=args.target,
@@ -108,7 +125,11 @@ def main() -> None:
             rollout_lag_sec=5.0,
             time_horizon=500,
             result_dir=run_dir,
-            model_dir=os.path.join(run_dir, "models"),
+            model_dir=(
+                os.path.abspath(args.resume_from)
+                if args.resume_from
+                else os.path.join(run_dir, "models")
+            ),
             model_save_interval=500,
             loss_log_interval=100,
         )
