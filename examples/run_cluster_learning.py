@@ -58,6 +58,12 @@ def main() -> None:
     )
     p.add_argument("--no-anneal", action="store_true")
     p.add_argument("--worker-step-sleep", type=float, default=0.02)
+    p.add_argument(
+        "--value-clip", type=float, nargs=2, default=None,
+        metavar=("LO", "HI"),
+        help="bounded-return V-trace value clamp (Config.value_target_clip); "
+        "CartPole at reward_scale 0.1 / gamma 0.99: 0 10",
+    )
     p.add_argument("--target", type=float, default=475.0,
                    help="stop early when the fleet 50-game mean reaches this")
     p.add_argument("--seed", type=int, default=0)
@@ -103,6 +109,9 @@ def main() -> None:
                 }
             ),
             stop_at_reward=args.target,
+            value_target_clip=(
+                tuple(args.value_clip) if args.value_clip else None
+            ),
             # Decisive for async learning (measured): without zero-init the
             # stale actor-stored carries drive bootstrapped value
             # hallucination (mean V > discounted cap) -> persistent negative

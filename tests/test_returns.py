@@ -87,3 +87,38 @@ def test_vtrace_on_policy_reduces_to_td_lambda_like(rng):
         np.testing.assert_allclose(
             dv[:, t], delta[:, t] + 0.9 * dv[:, t + 1], rtol=1e-4, atol=1e-5
         )
+
+
+def test_vtrace_value_clamp_bounds_hallucination(rng):
+    """v_min/v_max clamp both the bootstrap values entering the recursion and
+    the corrected targets: a critic hallucinating far above the achievable
+    return cap produces targets inside the bound, while in-bound values are
+    reference-exact (clip is a no-op)."""
+    B, S = 4, 6
+    shape = (B, S, 1)
+    behav = jnp.asarray(rng.normal(size=shape) * 0.1 - 0.7)
+    target = behav + jnp.asarray(rng.normal(size=shape) * 0.2)
+    rew = jnp.asarray(np.abs(rng.normal(size=shape)) * 0.1)
+    fir = jnp.zeros(shape)
+    cap = 9.93
+
+    # Hallucinated critic: values way above the cap.
+    v_bad = jnp.asarray(np.abs(rng.normal(size=shape)) * 5.0 + 20.0)
+    _, adv, vs = vtrace(
+        behav, target, fir, rew, v_bad, gamma=0.99, v_min=0.0, v_max=cap
+    )
+    assert float(jnp.max(vs)) <= cap + 1e-5
+    assert float(jnp.min(vs)) >= -1e-5
+    # The clamp feeds the advantage computation too: with values pinned at
+    # the cap and small positive rewards, advantages stay O(reward), not
+    # O(hallucination).
+    assert float(jnp.max(jnp.abs(adv))) < 5.0
+
+    # In-bound critic: clamp must be exactly transparent.
+    v_ok = jnp.asarray(np.abs(rng.normal(size=shape)))  # within [0, 9.93]
+    out_ref = vtrace(behav, target, fir, rew, v_ok, gamma=0.99)
+    out_clip = vtrace(
+        behav, target, fir, rew, v_ok, gamma=0.99, v_min=0.0, v_max=cap
+    )
+    for a, b in zip(out_ref, out_clip):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -29,6 +29,7 @@ def make_train_step(cfg: Config, family: ModelFamily):
     def loss_fn(params, batch: Batch):
         log_probs, entropy, value, logits = policy_outputs(family, params, batch)
 
+        v_lo, v_hi = cfg.value_target_clip or (None, None)
         ratio, advantages, values_target = vtrace(
             behav_log_probs=batch.log_prob,
             target_log_probs=jax.lax.stop_gradient(log_probs),
@@ -39,6 +40,8 @@ def make_train_step(cfg: Config, family: ModelFamily):
             rho_bar=cfg.rho_bar,
             rho_min=cfg.rho_min,
             c_bar=cfg.c_bar,
+            v_min=v_lo,
+            v_max=v_hi,
         )
 
         loss_policy = -jnp.mean(log_probs[:, :-1] * advantages)

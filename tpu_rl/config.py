@@ -84,6 +84,14 @@ class Config:
     rho_bar: float = 0.8
     rho_min: float = 0.1
     c_bar: float = 1.0
+    # Bounded-return value clamp [v_min, v_max] for the V-trace recursion
+    # (ops/returns.py): None = reference parity. For envs whose scaled
+    # discounted return is bounded by construction (CartPole at
+    # reward_scale 0.1 / gamma 0.99: [0, ~9.93]) this stops the async-lag
+    # value-hallucination spiral measured in CLUSTER_LEARNING.md — the
+    # rho-damped corrections cannot pull a drifting critic back, but the
+    # clamp caps the drift at the source.
+    value_target_clip: tuple[float, float] | None = None
 
     # V-MPO
     v_mpo_lagrange_multiplier_init: float = 5.0
@@ -256,6 +264,9 @@ class Config:
                 "has a continuous action space; use PPO-Continuous or "
                 "SAC-Continuous"
             )
+        if self.value_target_clip is not None:
+            lo, hi = self.value_target_clip  # must be a (lo, hi) pair
+            assert float(lo) < float(hi), self.value_target_clip
         if self.entropy_anneal is not None:
             a = self.entropy_anneal
             assert "coef" in a, "entropy_anneal needs 'coef' (final entropy_coef)"

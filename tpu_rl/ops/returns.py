@@ -51,6 +51,8 @@ def vtrace(
     rho_bar: float = 0.8,
     rho_min: float = 0.1,
     c_bar: float = 1.0,
+    v_min: float | None = None,
+    v_max: float | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """V-trace off-policy corrections (IMPALA).
 
@@ -64,7 +66,20 @@ def vtrace(
         dv[t] = delta[t] + c[t] * g*(1-fir[t+1]) * dv[t+1],  dv[S-1] = 0
         vs    = V + dv
         adv[t] = rho[t] * (r[t] + g*(1-fir[t+1])*vs[t+1] - V[t])
+
+    ``v_min``/``v_max`` (default None = reference parity) clamp the critic
+    values entering the recursion AND the resulting targets to the env's
+    achievable discounted-return range. Under async policy lag the reference
+    clips (rho <= rho_bar < 1) damp the corrections that would pull a
+    drifting critic back, and bootstrapped drift compounds — measured on
+    the cluster deployment: mean V exceeded the discounted cap, advantages
+    went persistently negative, entropy collapsed (CLUSTER_LEARNING.md).
+    For bounded-return envs the bound is known by construction, so
+    hallucination above it is clamped at the source; values inside the
+    bound are untouched.
     """
+    if v_min is not None or v_max is not None:
+        values = jnp.clip(values, v_min, v_max)
     log_ratio = target_log_probs[:, :-1] - behav_log_probs[:, :-1]
     ratio = jnp.exp(log_ratio)
     rho_clipped = jnp.clip(ratio, rho_min, rho_bar)
@@ -90,6 +105,9 @@ def vtrace(
     # full (B, S, 1) buffer, compute_loss.py:48).
     dv_full = jnp.concatenate([dv, jnp.zeros_like(dv[:, :1])], axis=1)
     values_target = values + dv_full
+    if v_min is not None or v_max is not None:
+        # The corrected targets are returns too: same achievable range.
+        values_target = jnp.clip(values_target, v_min, v_max)
 
     advantages = rho_clipped * (
         rewards[:, :-1] + disc * values_target[:, 1:] - values[:, :-1]
