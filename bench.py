@@ -209,6 +209,22 @@ WORKLOADS: list[tuple[str, dict, int, int]] = [
         ),
         3, 20,
     ),
+    # Same model with flash-style blockwise attention and 2x the batch: full
+    # attention materializes the (B, H, S, S) f32 score tensor per layer
+    # (~1 GB at these shapes) — an HBM-bound pattern that capped the row
+    # above at 14.7% MFU; blockwise streams (block, block) tiles through an
+    # online softmax (O(T) residuals, parallel/sequence.py) so HBM traffic
+    # drops to O(T*D) and the freed memory buys batch parallelism.
+    (
+        "PPO-transformer@longctx-blockwise",
+        dict(
+            algo="PPO", model="transformer", compute_dtype="bfloat16",
+            attention_impl="blockwise",
+            batch_size=16, seq_len=2048, hidden_size=512, n_heads=8,
+            n_layers=4, obs_shape=(64,), action_space=8,
+        ),
+        3, 20,
+    ),
 ]
 
 
