@@ -68,6 +68,10 @@ def main() -> None:
                    help="stop early when the fleet 50-game mean reaches this")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
+        "--max-hours", type=float, default=2.0,
+        help="hard wallclock cap on the whole run",
+    )
+    p.add_argument(
         "--resume-from", default=None,
         help="models dir of a previous run: the learner restores the newest "
         "checkpoint (params + optimizer + update counter) and the workers "
@@ -154,7 +158,7 @@ def main() -> None:
         ],
     )
     t0 = time.time()
-    deadline = t0 + 3600.0  # hard wallclock cap: never spin forever
+    deadline = t0 + args.max_hours * 3600.0  # hard cap: never spin forever
     sup = local_cluster(cfg, machines, max_updates=args.updates, seed=args.seed)
     try:
         learner = next(c for c in sup.children if c.name == "learner")
