@@ -250,11 +250,20 @@ def run(
                 flush=True,
             )
         if update % log_every == 0:
+            # SAC runs surface the temperature and critic loss: the two
+            # scalars that localize a rise-then-collapse (alpha undershoot
+            # vs critic divergence).
+            extra = ""
+            if "alpha" in metrics:
+                extra = (
+                    f"  alpha {float(metrics['alpha']):.4f}"
+                    f"  q-loss {float(metrics['value-loss']):+.4f}"
+                )
             print(
                 f"update {update:5d}  loss {float(metrics['loss']):+.4f}  "
                 f"mean-epi-rew {mean50():8.2f}  "
                 f"best {best_epi_rew:8.2f}  env-steps {env_steps:7d}  "
-                f"elapsed {time.time()-t0:6.1f}s",
+                f"elapsed {time.time()-t0:6.1f}s{extra}",
                 flush=True,
             )
     wallclock = time.time() - t0
