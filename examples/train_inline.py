@@ -231,9 +231,13 @@ def run(
         update += 1
         if switch_at is not None and update == switch_at:
             cfg = cfg.replace(
-                entropy_coef=float(anneal["coef"]),
+                entropy_coef=float(anneal.get("coef", cfg.entropy_coef)),
                 lr=float(anneal.get("lr", cfg.lr)),
                 std_floor=float(anneal.get("std_floor", cfg.std_floor)),
+                # SAC: release (or move) the temperature floor — hot phase
+                # guarantees exploration while the critic consolidates, cold
+                # phase lets the controller converge the policy.
+                alpha_min=float(anneal.get("alpha_min", cfg.alpha_min)),
             )
             if "std_floor" in anneal:
                 # std_floor is a static module attribute, not a parameter:
@@ -246,7 +250,8 @@ def run(
             train_step = jax.jit(spec.make_train_step(cfg, family))
             print(
                 f"update {update}: entropy_coef -> {cfg.entropy_coef}, "
-                f"lr -> {cfg.lr}, std_floor -> {cfg.std_floor}",
+                f"lr -> {cfg.lr}, std_floor -> {cfg.std_floor}, "
+                f"alpha_min -> {cfg.alpha_min}",
                 flush=True,
             )
         if update % log_every == 0:

@@ -103,6 +103,11 @@ def make_train_step(cfg: Config, family: ModelFamily):
         loss_alpha, g_alpha = jax.value_and_grad(alpha_loss_fn)(state.log_alpha)
         up, alpha_opt = opt_alpha.update(g_alpha, state.alpha_opt, state.log_alpha)
         log_alpha = optax.apply_updates(state.log_alpha, up)
+        if cfg.alpha_min > 0.0:
+            # Exploration floor (Config.alpha_min): clamp post-update so the
+            # controller can still raise alpha freely but cannot extinguish
+            # exploration on sparse-goal envs.
+            log_alpha = jnp.maximum(log_alpha, jnp.log(cfg.alpha_min))
 
         # ---- 3) critic update with updated actor + alpha (sac/learning.py:76-120)
         alpha2 = sg(jnp.exp(log_alpha))

@@ -74,6 +74,15 @@ class Config:
     # SAC
     alpha: float = 0.2
     tau: float = 0.005
+    # Temperature floor (0 = reference parity, no floor). The auto-tuned
+    # alpha shrinks until the policy's entropy matches the target, which on
+    # sparse-goal envs can extinguish exploration before the critic has
+    # consolidated the goal basin — measured on MountainCarContinuous seed
+    # 2: alpha decayed 0.117 -> 0.008 while the 50-game mean fell 64.5 ->
+    # -33 in lockstep (the rise-then-collapse of BASELINE_RESULTS row 11).
+    # A floor keeps exploration pressure alive, the off-policy analogue of
+    # std_floor for PPO-Continuous.
+    alpha_min: float = 0.0
     # SAC temperature target entropy; None = standard auto rule
     # (-dim(A) continuous, 0.98*log|A| discrete — see algos/sac.py for the
     # documented divergence from the reference's +action_space).
