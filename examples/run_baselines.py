@@ -134,24 +134,27 @@ CONFIGS: dict[str, dict] = {
         # ~30k post-warmup steps in, and a seed that hadn't locked on by
         # then (seed 1) never recovered; 32768 windows (~164k steps) out-
         # lives the 150k-step budget.
-        # Seed-2 rise-then-collapse, measured twice this round (alpha logged):
-        # the run is a RACE between policy-mean consolidation onto
-        # swing-pumping behavior and the decay of goal visits. Alpha decayed
-        # 0.117 -> 0.008 while the mean fell 64.5 -> -33 in lockstep; a hard
-        # alpha floor of 0.05 did NOT save it (same decline with alpha
-        # pinned) because iid Gaussian noise cannot re-reach the goal once
-        # the mean has migrated toward do-nothing — scripted bang-bang
-        # warmup episodes are the only goal-reaching data source (0/20
-        # random vs 20/20 sticky bang-bang, see above). So the fix is a
-        # denser goal prior: 2.5x the warmup (25 goal-rich episodes in a
-        # replay that outlives the budget) so the critic's goal basin is
-        # strong enough that every seed's policy mean locks on before
-        # exploration decays. alpha_min 0.05 + half-budget release retained
-        # as a second line of defense (it measurably slows the decay).
+        # Seed variance on the warmup-only recipe was measured EXHAUSTIVELY
+        # in round 4 (five instrumented reruns with alpha in the log line):
+        # without action_repeat the run is a RACE between policy-mean
+        # consolidation and the decay of goal visits, and roughly half the
+        # seeds lose it (alpha decays 0.117 -> 0.008 while the mean falls
+        # 64.5 -> -33 in lockstep; alpha floors, floor release schedules, a
+        # 10x slower temperature controller, and 5x warmup all failed
+        # measurably — temperature-side knobs either can't re-reach the
+        # goal once the mean migrates, or block the winning seeds'
+        # convergence too).
+        # action_repeat=8 — the SAME lever that is decisive for
+        # PPO-Continuous above — dissolves the race: each exploration
+        # decision (and its reparameterized noise) is HELD 8 env steps, so
+        # post-warmup exploration pumps the resonant swing and can always
+        # re-reach the goal (16/20 held vs 0/20 iid). The hardest seed
+        # (2: 0/5 failed attempts under every temperature-side recipe)
+        # solves in 52 s / ~2.1k updates; the decision horizon shrinks to
+        # ~125 so gamma 0.99 suffices.
         overrides=dict(
-            time_horizon=999, reward_scale=0.1, lr=3e-4, buffer_size=32768,
-            gamma=0.999, warmup_steps=25_000, alpha_min=0.05,
-            entropy_anneal={"alpha_min": 0.0, "frac": 0.5},
+            action_repeat=8, time_horizon=999, reward_scale=0.1, lr=3e-4,
+            buffer_size=32768, gamma=0.99, warmup_steps=10_000,
         ),
     ),
 }
