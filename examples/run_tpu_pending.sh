@@ -1,0 +1,43 @@
+#!/bin/sh
+# Round-4 on-chip measurement backlog — run on the TPU host the moment the
+# accelerator is reachable (the axon tunnel was down for all of rounds 3-4
+# after the first bench; probe first, everything below hangs otherwise):
+#
+#   timeout 90 python -c "import jax; print(jax.devices())"
+#
+# Each step writes its committed artifact; nothing here overwrites an
+# on-chip record with fallback numbers (bench.py routes CPU runs to
+# bench_results.cpu.json by itself).
+set -ex
+cd "$(dirname "$0")/.."
+
+# 1. Full learner matrix -> bench_results.json (now includes the
+#    dtype-matched IMPALA@wide-lstm-bf16 row and the blockwise-attention
+#    longctx row at 2x batch; expect the latter to lift the 14.7% MFU).
+python bench.py
+
+# 2. LSTM kernel-vs-scan -> bench_lstm_kernel.json. The dispatch is now
+#    measured-win-only; verify no row has auto_regression > 1.0 (the
+#    "force" mode times the raw kernel, including the fused backward at
+#    multi-tile shapes, which the old bench silently measured as
+#    kernel-fwd + scan-bwd).
+PYTHONPATH=. python examples/bench_lstm_kernel.py
+
+# 3. Long-context transformer profile (VERDICT r3 #6): step-level trace to
+#    attribute the remaining gap to attention vs FF vs data movement.
+#    View with tensorboard/xprof; summarize findings in README.
+PYTHONPATH=. python - <<'EOF'
+import jax
+import bench
+row = bench.bench_one(
+    "PPO-transformer@longctx-blockwise",
+    dict(
+        algo="PPO", model="transformer", compute_dtype="bfloat16",
+        attention_impl="blockwise", batch_size=16, seq_len=2048,
+        hidden_size=512, n_heads=8, n_layers=4, obs_shape=(64,),
+        action_space=8, profile_dir="/tmp/tpu_rl_longctx_trace",
+    ),
+    3, 20,
+)
+print(row)
+EOF
