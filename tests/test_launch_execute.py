@@ -41,15 +41,30 @@ def test_execute_two_role_deployment(tmp_path):
 
     workdir = tmp_path / "deploy"
     tmux_dir = tmp_path / "tmux"
+
+    # Free ports, not hardcoded ones: a concurrent run (or a crashed
+    # leftover holding the port) would otherwise kill the learner role on
+    # ZMQ bind inside its detached session — a confusing flake that isn't
+    # launch.py's fault. learner_port+1 is the model PUB (MachinesConfig),
+    # so reserve pairs.
+    import socket
+
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    learner_port = _free_port()
+    worker_port = _free_port()
     machines = {
         "learner_ip": "127.0.0.1",
-        "learner_port": 31510,
+        "learner_port": learner_port,
         "workers": [
             {
                 "num_p": 1,
                 "ip": "127.0.0.1",
                 "manager_ip": "127.0.0.1",
-                "port": 31514,
+                "port": worker_port,
             }
         ],
     }

@@ -217,11 +217,11 @@ class LSTMCell(nn.Module):
         # non-AD path and the "off" path can never diverge bit-wise.
         from tpu_rl.ops.pallas_lstm import _scan_forward
 
-        hs, cs = _scan_forward(
+        hs, (h_last, c_last) = _scan_forward(
             xp, self.recurrent_kernel, carry0[0], carry0[1], keep,
             matmul_dtype=self.dtype,
         )
-        return (hs[:, -1], cs[:, -1]), hs
+        return (h_last, c_last), hs
 
     @staticmethod
     def zero_carry(hidden: int, batch_shape: tuple[int, ...] = ()) -> Carry:

@@ -190,7 +190,7 @@ def bwd_batch_tile(batch: int, seq: int, hidden: int) -> int | None:
     return _best_tile(batch, fits)
 
 
-def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None):
+def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None, want_cs=False):
     """Plain ``lax.scan`` forward over the precomputed input projection —
     the measured winner for UNdifferentiated unrolls (the fused kernel is
     0.82-0.99x the scan on forward-only at every benched shape,
@@ -200,7 +200,14 @@ def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None):
     ``matmul_dtype`` (e.g. ``jnp.bfloat16``) casts ONLY the recurrent
     matmul operands — MXU-rate compute with f32 accumulation
     (``preferred_element_type``); the carry, gate math, and outputs stay
-    float32. None = pure float32 (bit-identical to the fused kernel)."""
+    float32. None = pure float32 (bit-identical to the fused kernel).
+
+    Returns ``(hs, (h_last, c_last))`` by default; ``want_cs=True`` stacks
+    the full per-step cell state and returns ``(hs, cs)`` instead — only
+    the ``lstm_unroll`` primal needs that (its custom_vjp output contract
+    is (B,S,H) pairs); every other caller consumes just the final carry,
+    and stacking cs for them would write an extra (B,S,H) buffer per
+    forward (~64 MB at the wide bench shape)."""
     wh_m = wh if matmul_dtype is None else wh.astype(matmul_dtype)
 
     def step(carry, xs):
@@ -220,12 +227,15 @@ def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None):
         o = jax.nn.sigmoid(z[:, 3 * H :])
         c2 = f * c + i * g
         h2 = o * jnp.tanh(c2)
-        return (h2, c2), (h2, c2)
+        return (h2, c2), ((h2, c2) if want_cs else h2)
 
-    _, (hs, cs) = jax.lax.scan(
+    (h_last, c_last), out = jax.lax.scan(
         step, (h0, c0), (jnp.moveaxis(xp, 1, 0), jnp.moveaxis(keep, 1, 0))
     )
-    return jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1)
+    if want_cs:
+        hs, cs = out
+        return jnp.moveaxis(hs, 0, 1), jnp.moveaxis(cs, 0, 1)
+    return jnp.moveaxis(out, 0, 1), (h_last, c_last)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
@@ -249,7 +259,7 @@ def lstm_unroll(xp, wh, h0, c0, keep, interpret=False):
             xp, wh, h0, c0, keep, interpret, save_acts=False
         )
         return hs, cs
-    return _scan_forward(xp, wh, h0, c0, keep)
+    return _scan_forward(xp, wh, h0, c0, keep, want_cs=True)
 
 
 def _fwd(xp, wh, h0, c0, keep, interpret):
