@@ -30,7 +30,12 @@ sg = jax.lax.stop_gradient
 
 
 def make_train_step(cfg: Config, family: ModelFamily):
-    opt_actor, opt_critic, opt_alpha = adam(cfg), adam(cfg), adam(cfg)
+    opt_actor, opt_critic = adam(cfg), adam(cfg)
+    opt_alpha = (
+        adam(cfg)
+        if cfg.alpha_lr is None
+        else adam(cfg.replace(lr=cfg.alpha_lr))
+    )
     continuous = family.continuous
     # Target entropy — documented divergence from the reference, which sets
     # target = +action_space for BOTH variants (``learner.py:363-365``).

@@ -83,6 +83,13 @@ class Config:
     # A floor keeps exploration pressure alive, the off-policy analogue of
     # std_floor for PPO-Continuous.
     alpha_min: float = 0.0
+    # Temperature-controller learning rate; None = cfg.lr (reference parity:
+    # one Adam lr for all three optimizers, agents/learner.py:360-367).
+    # Slowing ONLY the alpha controller stretches the exploration-decay
+    # clock without moving its equilibrium — on sparse-goal envs the decay
+    # otherwise outruns critic/policy consolidation (the measured
+    # MountainCarContinuous seed-2 race; see alpha_min).
+    alpha_lr: float | None = None
     # SAC temperature target entropy; None = standard auto rule
     # (-dim(A) continuous, 0.98*log|A| discrete — see algos/sac.py for the
     # documented divergence from the reference's +action_space).
