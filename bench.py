@@ -103,10 +103,26 @@ def _sync(metrics) -> float:
     return float(np.asarray(jax.device_get(metrics["loss"])))
 
 
-def bench_one(name: str, cfg_kw: dict, warmup: int, iters: int) -> dict:
+def bench_one(
+    name: str, cfg_kw: dict, warmup: int, iters: int, chain: int = 1
+) -> dict:
+    """One workload row. ``chain > 1`` compiles K updates per dispatched
+    program (``make_parallel_train_step(chain=K)``): through a remote-
+    execution tunnel every dispatch pays a fixed RTT (~3-5 ms measured this
+    round vs ~0.5 ms in round 3), which swamps the sub-ms reference-quantum
+    update and would report tunnel latency as learner throughput. Chaining
+    amortizes dispatch to RTT/K per update, so the row measures the chip's
+    sustainable update rate — what the reference's local-GPU timer measures
+    (``/root/reference/utils/utils.py:174-189``)."""
     from tpu_rl.algos.registry import get_algo
     from tpu_rl.config import Config
-    from tpu_rl.parallel import make_mesh, make_parallel_train_step, replicate, shard_batch
+    from tpu_rl.parallel import (
+        make_mesh,
+        make_parallel_train_step,
+        replicate,
+        shard_batch,
+        shard_chained_batch,
+    )
 
     cfg = Config.from_dict(cfg_kw)
     family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(0))
@@ -114,15 +130,22 @@ def bench_one(name: str, cfg_kw: dict, warmup: int, iters: int) -> dict:
     # Use every visible chip; keep the global batch at the workload quantum.
     n_dev = n_vis if cfg.batch_size % n_vis == 0 else 1
     mesh = make_mesh(n_dev)
-    pstep = make_parallel_train_step(train_step, mesh, cfg)
-
-    batch = shard_batch(_make_batch(cfg, family), mesh)
+    pstep = make_parallel_train_step(train_step, mesh, cfg, chain=chain)
+    if chain > 1:
+        one = _make_batch(cfg, family)
+        batch = shard_chained_batch([one] * chain, mesh)
+    else:
+        batch = shard_batch(_make_batch(cfg, family), mesh)
     state = replicate(state, mesh)
     key = replicate(jax.random.key(1), mesh)
 
     lowered = pstep.lower(state, batch, key)
     compiled = lowered.compile()
     cost = compiled.cost_analysis() or {}
+    # XLA's cost analysis counts a scan/while body ONCE regardless of trip
+    # count (verified: the K=4 chained program reports the same total flops
+    # as the unchained step), so the chained program's count already IS
+    # per-update.
     flops_per_step = float(cost.get("flops", 0.0))
 
     metrics = None
@@ -140,8 +163,9 @@ def bench_one(name: str, cfg_kw: dict, warmup: int, iters: int) -> dict:
     dt = time.perf_counter() - t0
 
     transitions = cfg.batch_size * cfg.seq_len
-    tps = iters * transitions / dt
-    achieved = flops_per_step * iters / dt
+    updates = iters * chain
+    tps = updates * transitions / dt
+    achieved = flops_per_step * updates / dt
     peak = device_peak_flops()
     mfu = (achieved / (peak * n_dev)) if (peak and achieved) else None
     return {
@@ -152,7 +176,8 @@ def bench_one(name: str, cfg_kw: dict, warmup: int, iters: int) -> dict:
         "batch": cfg.batch_size,
         "seq": cfg.seq_len,
         "hidden": cfg.hidden_size,
-        "step_ms": round(dt / iters * 1e3, 3),
+        "steps_per_call": chain,
+        "step_ms": round(dt / updates * 1e3, 3),
         "tps": round(tps, 1),
         "flops_per_step": flops_per_step,
         "achieved_flops_per_s": round(achieved, 1),
@@ -173,20 +198,24 @@ _REF = dict(batch_size=128, seq_len=5, hidden_size=64)
 _DISC = dict(obs_shape=(4,), action_space=2)
 _CONT = dict(obs_shape=(2,), action_space=1, is_continuous=True)
 
-WORKLOADS: list[tuple[str, dict, int, int]] = [
-    ("IMPALA@ref", dict(algo="IMPALA", **_REF, **_DISC), 10, 200),
-    ("PPO@ref", dict(algo="PPO", **_REF, **_DISC), 10, 200),
-    ("V-MPO@ref", dict(algo="V-MPO", **_REF, **_DISC), 10, 200),
-    ("SAC@ref", dict(algo="SAC", **_REF, **_DISC), 10, 100),
-    ("PPO-Continuous@ref", dict(algo="PPO-Continuous", **_REF, **_CONT), 10, 200),
-    ("SAC-Continuous@ref", dict(algo="SAC-Continuous", **_REF, **_CONT), 10, 100),
+# (name, cfg, warmup_calls, timed_calls, updates_per_call). The @ref rows
+# chain 16 updates per dispatched program (make_parallel_train_step(chain=16),
+# tpu_rl/parallel/dp.py): their per-update compute is sub-ms, so a
+# per-dispatch tunnel RTT would otherwise dominate the measurement.
+WORKLOADS: list[tuple[str, dict, int, int, int]] = [
+    ("IMPALA@ref", dict(algo="IMPALA", **_REF, **_DISC), 5, 50, 16),
+    ("PPO@ref", dict(algo="PPO", **_REF, **_DISC), 5, 50, 16),
+    ("V-MPO@ref", dict(algo="V-MPO", **_REF, **_DISC), 5, 50, 16),
+    ("SAC@ref", dict(algo="SAC", **_REF, **_DISC), 5, 25, 16),
+    ("PPO-Continuous@ref", dict(algo="PPO-Continuous", **_REF, **_CONT), 5, 50, 16),
+    ("SAC-Continuous@ref", dict(algo="SAC-Continuous", **_REF, **_CONT), 5, 25, 16),
     (
         "IMPALA@wide-lstm",
         dict(
             algo="IMPALA", batch_size=1024, seq_len=16, hidden_size=1024,
             obs_shape=(64,), action_space=8,
         ),
-        5, 30,
+        5, 30, 1,
     ),
     # Same workload with bf16 matmul compute (params f32, f32 accumulation;
     # models/cells.py): the dtype-matched chip-capability row — its MFU is
@@ -198,7 +227,7 @@ WORKLOADS: list[tuple[str, dict, int, int]] = [
             algo="IMPALA", batch_size=1024, seq_len=16, hidden_size=1024,
             obs_shape=(64,), action_space=8, compute_dtype="bfloat16",
         ),
-        5, 30,
+        5, 30, 1,
     ),
     (
         "PPO-transformer@longctx",
@@ -207,7 +236,7 @@ WORKLOADS: list[tuple[str, dict, int, int]] = [
             batch_size=8, seq_len=2048, hidden_size=512, n_heads=8,
             n_layers=4, obs_shape=(64,), action_space=8,
         ),
-        3, 20,
+        3, 20, 1,
     ),
     # Same model with flash-style blockwise attention and 2x the batch: full
     # attention materializes the (B, H, S, S) f32 score tensor per layer
@@ -223,7 +252,7 @@ WORKLOADS: list[tuple[str, dict, int, int]] = [
             batch_size=16, seq_len=2048, hidden_size=512, n_heads=8,
             n_layers=4, obs_shape=(64,), action_space=8,
         ),
-        3, 20,
+        3, 20, 1,
     ),
 ]
 
@@ -247,9 +276,9 @@ def run_all(out_path: str | None = None) -> dict:
             out_path = "bench_results.light.json"
         else:
             out_path = "bench_results.json"
-    for name, cfg_kw, warmup, iters in workloads:
+    for name, cfg_kw, warmup, iters, chain in workloads:
         try:
-            row = bench_one(name, cfg_kw, warmup, iters)
+            row = bench_one(name, cfg_kw, warmup, iters, chain)
         except Exception as e:  # record, don't abort the whole matrix
             row = {"name": name, "error": f"{type(e).__name__}: {e}"}
         rows.append(row)
@@ -281,9 +310,12 @@ def run_all(out_path: str | None = None) -> dict:
     return out
 
 
-def run(warmup: int = 10, iters: int = 200) -> dict:
-    """Back-compat single-workload entry (headline row only)."""
-    row = bench_one("IMPALA@ref", dict(algo="IMPALA", **_REF, **_DISC), warmup, iters)
+def run(warmup: int = 5, iters: int = 50) -> dict:
+    """Back-compat single-workload entry (headline row only; same chained
+    methodology as the run_all headline so the two entries agree)."""
+    row = bench_one(
+        "IMPALA@ref", dict(algo="IMPALA", **_REF, **_DISC), warmup, iters, 16
+    )
     return {
         "metric": "learner FPS (IMPALA V-trace, batch 128 x seq 5)",
         "value": row["tps"],

@@ -13,6 +13,7 @@ from tpu_rl.parallel.dp import (
     make_sp_train_step,
     replicate,
     shard_batch,
+    shard_chained_batch,
 )
 from tpu_rl.parallel.sequence import (
     SEQ_AXIS,
@@ -35,6 +36,7 @@ __all__ = [
     "make_sp_train_step",
     "replicate",
     "shard_batch",
+    "shard_chained_batch",
     "full_attention",
     "ring_attention",
     "ulysses_attention",

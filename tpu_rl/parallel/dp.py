@@ -17,20 +17,35 @@ sharded dimension with the required cross-device exchanges.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpu_rl.config import Config
-from tpu_rl.parallel.mesh import batch_sharding, check_divisible, replicated
+from tpu_rl.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    check_divisible,
+    replicated,
+)
 from tpu_rl.types import Batch
 
 
 def make_parallel_train_step(
-    train_step: Callable, mesh, cfg: Config | None = None
+    train_step: Callable, mesh, cfg: Config | None = None, chain: int = 1
 ) -> Callable:
     """Wrap a pure ``train_step(state, batch, key) -> (state, metrics)`` in a
-    jit with DP shardings. Returns the compiled callable."""
+    jit with DP shardings. Returns the compiled callable.
+
+    ``chain > 1`` compiles K sequential optimizer updates per dispatched
+    program: the batch gains a leading ``chain`` axis (one slice per update,
+    sharded ``P(None, "data")``), an inner ``lax.scan`` folds a fresh RNG key
+    per update, and the last update's metrics are returned. Per-update math is
+    identical to K separate calls; what changes is that fixed per-dispatch
+    overhead (host dispatch, or RTT through a remote-execution tunnel) is
+    paid once per K updates instead of per update."""
     if cfg is not None:
         check_divisible(cfg.batch_size, mesh)
 
@@ -44,15 +59,31 @@ def make_parallel_train_step(
         prev = cells._DATA_MESH
         cells.set_data_mesh(mesh)
         try:
-            return train_step(state, batch, key)
+            if chain == 1:
+                return train_step(state, batch, key)
+
+            def body(st, xs):
+                b, i = xs
+                st, m = train_step(st, b, jax.random.fold_in(key, i))
+                return st, m
+
+            state, ms = jax.lax.scan(
+                body, state, (batch, jnp.arange(chain))
+            )
+            return state, jax.tree.map(lambda x: x[-1], ms)
         finally:
             cells.set_data_mesh(prev)
 
-    bs, rs = batch_sharding(mesh), replicated(mesh)
+    rs = replicated(mesh)
+    bs = (
+        batch_sharding(mesh)
+        if chain == 1
+        else NamedSharding(mesh, P(None, DATA_AXIS))
+    )
     return jax.jit(
         traced_step,
         # Pytree-prefix shardings: state & key replicated, every batch leaf
-        # sharded along its leading dim.
+        # sharded along its leading dim (update axis first when chained).
         in_shardings=(rs, bs, rs),
         out_shardings=(rs, rs),
         donate_argnums=(0,),
@@ -83,6 +114,15 @@ def make_sp_train_step(train_step: Callable, mesh, cfg: Config | None = None):
         out_shardings=(rs, rs),
         donate_argnums=(0,),
     )
+
+
+def shard_chained_batch(batches: Sequence[Batch], mesh) -> Batch:
+    """Stack K per-update batches on a leading update axis and place them for
+    a ``make_parallel_train_step(chain=K)`` program: update axis replicated
+    (scan consumes it sequentially), batch axis sharded on ``"data"``. The
+    single source of the chained-batch layout contract."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    return jax.device_put(stacked, NamedSharding(mesh, P(None, DATA_AXIS)))
 
 
 def shard_batch(batch: Batch, mesh) -> Batch:
