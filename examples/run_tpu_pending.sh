@@ -1,7 +1,13 @@
 #!/bin/sh
-# Round-4 on-chip measurement backlog — run on the TPU host the moment the
-# accelerator is reachable (the axon tunnel was down for all of rounds 3-4
-# after the first bench; probe first, everything below hangs otherwise):
+# On-chip measurement backlog — run on the TPU host the moment the
+# accelerator is reachable (probe first, everything below hangs otherwise).
+# Step 1 (bench matrix) WAS completed in round 4's 03:45-04:10 UTC tunnel
+# window (RUN_TPU_r04.md); steps 2-3 remain pending — the tunnel died again
+# before they ran. Note the tunnel's per-dispatch RTT when it returned was
+# ~3-5 ms (vs ~0.5 ms round 3): bench.py's @ref rows now chain 16 updates
+# per dispatch to amortize it; bench_lstm_kernel.py timings below are
+# per-dispatch and will carry that RTT as a constant additive floor on both
+# kernel and scan rows (ratios stay meaningful).
 #
 #   timeout 90 python -c "import jax; print(jax.devices())"
 #
