@@ -254,6 +254,20 @@ WORKLOADS: list[tuple[str, dict, int, int, int]] = [
         ),
         3, 20, 1,
     ),
+    # Pallas TPU fused-attention kernel (parallel/sequence.py
+    # flash_attention_tpu) at the same 2x batch the blockwise row buys: the
+    # kernel keeps blockwise's O(T) memory without its jnp-level recompute
+    # overhead, so this row should dominate both transformer rows above.
+    (
+        "PPO-transformer@longctx-flash",
+        dict(
+            algo="PPO", model="transformer", compute_dtype="bfloat16",
+            attention_impl="flash",
+            batch_size=16, seq_len=2048, hidden_size=512, n_heads=8,
+            n_layers=4, obs_shape=(64,), action_space=8,
+        ),
+        3, 20, 1,
+    ),
 ]
 
 

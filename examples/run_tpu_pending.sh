@@ -17,9 +17,11 @@
 set -ex
 cd "$(dirname "$0")/.."
 
-# 1. Full learner matrix -> bench_results.json (now includes the
-#    dtype-matched IMPALA@wide-lstm-bf16 row and the blockwise-attention
-#    longctx row at 2x batch; expect the latter to lift the 14.7% MFU).
+# 1. Full learner matrix -> bench_results.json. Run 4 of round 4 added the
+#    PPO-transformer@longctx-flash row (Pallas TPU fused-attention kernel,
+#    NEVER yet executed on a real chip — the CPU tests only pin its masking
+#    spec); if it errors, the row records the error without aborting the
+#    matrix, and the committed table keeps the other rows.
 python bench.py
 
 # 2. LSTM kernel-vs-scan -> bench_lstm_kernel.json. The dispatch is now

@@ -234,3 +234,93 @@ class TestBlockwiseAttention:
         np.testing.assert_allclose(
             np.asarray(vb), np.asarray(vf), rtol=1e-5, atol=1e-5
         )
+
+
+class TestFlashImpl:
+    """The "flash" impl (Pallas TPU fused kernel, tpu_rl.parallel.sequence
+    .flash_attention_tpu). Mosaic kernels cannot execute on the CPU test
+    backend, so these tests pin the two facts the TPU path relies on:
+    (1) the kernel's argument encoding — causal-by-index + SegmentIds +
+    sm_scale — computes OUR mask contract (verified against mha_reference,
+    the library's pure-jnp spec of the kernel), and (2) off-TPU the impl
+    falls back to full_attention exactly."""
+
+    def _reference(self, q, k, v, seg):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            SegmentIds,
+            mha_reference,
+        )
+
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        tr = lambda x: x.transpose(0, 2, 1, 3)
+        out = mha_reference(
+            tr(q), tr(k), tr(v), None,
+            segment_ids=SegmentIds(q=seg, kv=seg),
+            causal=True, sm_scale=float(scale),
+        )
+        return tr(out)
+
+    def test_kernel_spec_matches_full_attention(self, rng):
+        """Global positions (the _inputs default)."""
+        q, k, v, pos, seg = _inputs(rng, T=32)
+        want = full_attention(q, k, v, pos, seg, causal=True)
+        got = self._reference(q, k, v, seg)
+        # mha_reference matmuls in bf16 precision; masking disagreements
+        # would produce O(1) differences, not 1e-2.
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-2, atol=3e-2
+        )
+
+    def test_kernel_spec_matches_segment_relative_positions(self, rng):
+        """The transformer passes SEGMENT-RELATIVE positions (restart at
+        seams); causal-by-global-index must still be equivalent because
+        positions are monotone within a segment and the segment mask kills
+        cross-segment pairs."""
+        q, k, v, _, seg = _inputs(rng, T=32, n_segments=4)
+        idx = np.broadcast_to(np.arange(32, dtype=np.int32), seg.shape)
+        seg_np = np.asarray(seg)
+        # position of each row within its segment
+        starts = np.zeros_like(idx)
+        for b in range(seg_np.shape[0]):
+            for t in range(1, 32):
+                starts[b, t] = (
+                    t if seg_np[b, t] != seg_np[b, t - 1] else starts[b, t - 1]
+                )
+        pos_rel = jnp.asarray(idx - starts)
+        want = full_attention(q, k, v, pos_rel, seg, causal=True)
+        got = self._reference(q, k, v, seg)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-2, atol=3e-2
+        )
+
+    def test_falls_back_to_full_off_tpu(self, rng):
+        from tpu_rl.parallel.sequence import flash_attention_tpu
+
+        if jax.default_backend() == "tpu":
+            pytest.skip("fallback path only exists off-TPU")
+        q, k, v, pos, seg = _inputs(rng)
+        want = full_attention(q, k, v, pos, seg, causal=True)
+        got = flash_attention_tpu(q, k, v, pos, seg, causal=True)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_transformer_flash_config_builds_and_matches_full(self, rng):
+        from tests.conftest import small_config
+        from tpu_rl.models.families import build_family
+
+        kw = dict(
+            algo="PPO", model="transformer", hidden_size=32, n_heads=4,
+            n_layers=2, seq_len=32, batch_size=2, obs_shape=(4,),
+            action_space=2,
+        )
+        fam_f = build_family(small_config(**kw, attention_impl="full"))
+        fam_x = build_family(small_config(**kw, attention_impl="flash"))
+        params = fam_f.init_params(jax.random.key(0), seq_len=32)
+        obs = jnp.asarray(rng.normal(size=(2, 32, 4)).astype(np.float32))
+        firsts = np.zeros((2, 32, 1), np.float32)
+        firsts[:, 0] = 1.0
+        firsts[1, 7] = 1.0
+        firsts = jnp.asarray(firsts)
+        lf, vf, _ = fam_f.actor_unroll(params["actor"], obs, None, firsts)
+        lx, vx, _ = fam_x.actor_unroll(params["actor"], obs, None, firsts)
+        np.testing.assert_array_equal(np.asarray(lx), np.asarray(lf))
+        np.testing.assert_array_equal(np.asarray(vx), np.asarray(vf))

@@ -243,7 +243,9 @@ class Config:
         # module dtype (transformer.py), the LSTM families via
         # LSTMCell.dtype mixed precision (params f32, matmul compute bf16,
         # carry/gates/heads f32 — models/cells.py).
-        assert self.attention_impl in ("full", "blockwise", "ring", "ulysses")
+        assert self.attention_impl in (
+            "full", "blockwise", "flash", "ring", "ulysses"
+        )
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs
         assert self.action_repeat >= 1, self.action_repeat

@@ -102,9 +102,10 @@ class MultiHeadAttention(nn.Module):
                 out_specs=qs,
             )
             o = attn(q, k, v, pos, seg)
-        elif self.attention_impl == "blockwise":
-            # Single-device memory-efficient path: O(block^2) transients
-            # instead of the (T, T) score matrix.
+        elif self.attention_impl in ("blockwise", "flash"):
+            # Single-device paths: blockwise = O(block^2) transients instead
+            # of the (T, T) score matrix; flash = the Pallas TPU fused kernel
+            # (falls back to full attention off-TPU).
             o = impl(q, k, v, pos, seg, causal=True)
         else:
             o = full_attention(q, k, v, pos, seg, causal=True)

@@ -495,9 +495,92 @@ def _blockwise_vjp_bwd(causal, block, res, do):
 _blockwise_vjp.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
 
 
+def flash_attention_tpu(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    q_pos: jax.Array,
+    seg: jax.Array,
+    axis_name: str | None = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Single-device fused attention via the Pallas TPU flash-attention
+    kernel that ships with JAX (``jax.experimental.pallas.ops.tpu
+    .flash_attention``; custom-VJP fwd+bwd Mosaic kernels). Same contract as
+    :func:`full_attention`.
+
+    Masking equivalence: the kernel takes ``causal`` (by global index) plus
+    ``SegmentIds`` — identical to our ``q_pos >= k_pos`` + same-segment mask
+    because positions are segment-relative and monotone within a segment, and
+    the segment mask kills every cross-segment pair anyway
+    (``tests/test_sequence_parallel.py::TestFlashImpl`` pins this against
+    ``mha_reference``, the kernel's own pure-jnp spec).
+
+    Off-TPU (CPU tests, the virtual mesh) Mosaic kernels cannot run, so this
+    falls back to :func:`full_attention` — bit-compatible masking, different
+    arithmetic order. Under a data-parallel mesh the Mosaic call cannot be
+    auto-partitioned by GSPMD, so — per the LSTM-kernel pattern in
+    ``models/cells.py`` — the kernel runs as a ``shard_map`` island over the
+    ``"data"`` axis whenever ``make_parallel_train_step`` has registered its
+    mesh (including the 1-device case, so the single-chip bench exercises
+    the same island multi-chip uses). The sharded LONG-CONTEXT (seq-axis)
+    path remains ``ring``/``ulysses``.
+    """
+    if jax.default_backend() != "tpu":
+        return full_attention(q, k, v, q_pos, seg, causal=causal)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention as _pallas_flash,
+    )
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    def kernel(q, k, v, seg):
+        # our layout (B, T, H, D) -> kernel layout (B, H, T, D)
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        seg32 = seg.astype(jnp.int32)
+        o = _pallas_flash(
+            qt, kt, vt,
+            segment_ids=SegmentIds(q=seg32, kv=seg32),
+            causal=causal,
+            sm_scale=float(scale),
+        )
+        return o.transpose(0, 2, 1, 3)
+
+    from tpu_rl.models import cells
+
+    mesh = cells._DATA_MESH
+    mesh_tiles = (
+        mesh is not None
+        and DATA_AXIS in mesh.shape
+        and q.shape[0] % mesh.shape[DATA_AXIS] == 0
+    )
+    if mesh_tiles:
+        from jax.sharding import PartitionSpec as P
+
+        qs = P(DATA_AXIS, None, None, None)
+        return jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(qs, qs, qs, P(DATA_AXIS, None)),
+            out_specs=qs,
+            # No collectives inside; pallas out_shapes carry no vma
+            # annotations, so varying-axis checking must be off (same as
+            # the cells.py LSTM island).
+            check_vma=False,
+        )(q, k, v, seg)
+    if len(jax.devices()) > 1:
+        # Multi-device program with no registered/tiling mesh (init trace,
+        # eval outside make_parallel_train_step): a bare Mosaic custom call
+        # has no GSPMD partitioning rule, so take the partitionable jnp path.
+        return full_attention(q, k, v, q_pos, seg, causal=causal)
+    return kernel(q, k, v, seg)
+
+
 ATTENTION_IMPLS = {
     "full": full_attention,
     "blockwise": blockwise_attention,
+    "flash": flash_attention_tpu,
     "ring": ring_attention,
     "ulysses": ulysses_attention,
 }
