@@ -1,0 +1,142 @@
+"""Multi-leg cluster-replication driver.
+
+Runs ``run_cluster_learning.py`` legs back-to-back with the phase schedule
+that solved seed 0 (CLUSTER_SOLVED.md): one fresh hot->cold leg, then
+alternating cold (lr 1e-4 — the phase that produces 400+ breakout cycles)
+and cool (lr 3e-5, entropy 2e-5 — the phase that consolidates a breakout
+into a monotone climb) resume legs, all sharing one models dir, until the
+fleet 50-game mean reaches the target or the wallclock budget runs out.
+The round-4 seed-1 attempt established that the cool phase alone cannot
+break out of the 140-250 band (CLUSTER_SOLVED.md "Seed-1 replication") —
+the alternation is the recipe, automated here so a full replication needs
+no operator in the loop.
+
+Each leg's JSON result line (printed by run_cluster_learning) is parsed
+for ``solved``; per-leg records land in ``<dir>/leg<i>.md`` +
+``<dir>/chain.jsonl``. Reference topology being exercised:
+``/root/reference/main.py:301-414``; success criterion
+``/root/reference/README.md:18-21``.
+
+Usage (background, one shared CPU core — keep the host quiet):
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo nohup python \
+      examples/run_cluster_seed_chain.py --seed 1 --budget-hours 8 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+def run_leg(script: str, leg_args: list[str], out_path: str) -> dict | None:
+    """Run one leg; return its parsed JSON result line (None if missing)."""
+    cmd = [sys.executable, script] + leg_args + ["--out", out_path]
+    print(f"[chain] leg: {' '.join(cmd)}", flush=True)
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    result = None
+    for line in (proc.stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    if proc.returncode != 0:
+        tail = (proc.stderr or "")[-2000:]
+        print(f"[chain] leg rc={proc.returncode}\n{tail}", flush=True)
+    return result
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--target", type=float, default=475.0)
+    p.add_argument("--budget-hours", type=float, default=8.0)
+    p.add_argument("--leg-hours", type=float, default=2.0)
+    p.add_argument("--dir", default=None, help="chain dir (runs/seed<N>_chain)")
+    p.add_argument("--base-port", type=int, default=30400)
+    p.add_argument(
+        "--resume-from", default=None,
+        help="existing models dir: skip the fresh leg and start the "
+        "cold/cool alternation from this checkpoint",
+    )
+    args = p.parse_args()
+
+    chain_dir = os.path.abspath(args.dir or f"runs/seed{args.seed}_chain")
+    os.makedirs(chain_dir, exist_ok=True)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "run_cluster_learning.py")
+    log = open(os.path.join(chain_dir, "chain.jsonl"), "a")
+
+    common = [
+        "--seed", str(args.seed),
+        "--target", str(args.target),
+        "--value-clip", "0", "10",
+        "--base-port", str(args.base_port),
+        "--run-dir", chain_dir,
+        "--updates", "40000",  # per-leg incremental cap; wallclock governs
+    ]
+    deadline = time.time() + args.budget_hours * 3600.0
+
+    def hours_left() -> float:
+        return (deadline - time.time()) / 3600.0
+
+    models_dir = args.resume_from and os.path.abspath(args.resume_from)
+    leg_i = 0
+    solved = False
+    # Don't start a leg with less than ~12 min (or one leg-length) left —
+    # too short to learn anything, long enough to corrupt nothing.
+    min_leg = min(0.2, args.leg_hours)
+    while not solved and hours_left() > min_leg:
+        leg_i += 1
+        leg_h = min(args.leg_hours, hours_left())
+        out = os.path.join(chain_dir, f"leg{leg_i}.md")
+        if models_dir is None:
+            # fresh hot->cold leg (seed-0 leg-1 recipe)
+            leg = common + [
+                "--anneal-at", "3200", "--max-hours", f"{leg_h:.3f}",
+            ]
+        elif leg_i % 2 == 0:
+            # cold cycling leg: default anneal (entropy 5e-5, lr 1e-4)
+            leg = common + [
+                "--anneal-at", "0", "--max-hours", f"{leg_h:.3f}",
+                "--resume-from", models_dir,
+            ]
+        else:
+            # cool consolidation leg
+            leg = common + [
+                "--anneal-at", "0", "--anneal-coef", "2e-5",
+                "--anneal-lr", "3e-5", "--max-hours", f"{leg_h:.3f}",
+                "--resume-from", models_dir,
+            ]
+        result = run_leg(script, leg, out)
+        if result is None:
+            print("[chain] leg produced no result line; stopping", flush=True)
+            break
+        result["leg"] = leg_i
+        result["phase"] = (
+            "fresh" if "--resume-from" not in leg
+            else ("cold" if leg_i % 2 == 0 else "cool")
+        )
+        print(f"[chain] leg {leg_i}: {json.dumps(result)}", flush=True)
+        log.write(json.dumps(result) + "\n")
+        log.flush()
+        if models_dir is None:
+            # all later legs resume the first leg's models dir
+            run_subdirs = sorted(
+                d for d in os.listdir(chain_dir)
+                if os.path.isdir(os.path.join(chain_dir, d, "models"))
+            )
+            if run_subdirs:
+                models_dir = os.path.join(chain_dir, run_subdirs[0], "models")
+        solved = bool(result.get("solved"))
+    print(f"[chain] done: solved={solved} after {leg_i} legs", flush=True)
+    sys.exit(0 if solved else 3)
+
+
+if __name__ == "__main__":
+    main()
