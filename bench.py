@@ -347,25 +347,9 @@ ZERO_HEADLINE = {
 
 
 def _accelerator_reachable(timeout_s: float = 120.0) -> str | None:
-    """Probe device init in a BOUNDED subprocess; returns None when healthy,
-    else a short failure description. The axon TPU tunnel can hang
-    ``jax.devices()`` indefinitely when unhealthy (observed 2026-07-30: even
-    device enumeration never returns); a hang inside this process could not
-    be recovered, so the probe must be a child we can kill."""
-    import subprocess
+    from tpu_rl.utils.platform import accelerator_reachable
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-    except subprocess.TimeoutExpired:
-        return f"device init hung >{timeout_s:.0f}s"
-    if proc.returncode != 0:
-        tail = (proc.stderr or b"").decode(errors="replace").strip()[-200:]
-        return f"device init failed rc={proc.returncode}: {tail}"
-    return None
+    return accelerator_reachable(timeout_s)
 
 
 if __name__ == "__main__":

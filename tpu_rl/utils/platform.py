@@ -20,6 +20,43 @@ Two call sites depend on this:
 from __future__ import annotations
 
 
+def backend_initialized() -> bool:
+    """True when this process has already initialized a jax backend (so a
+    child-process probe would be redundant — and could even fail spuriously
+    against a single-client accelerator the parent already holds)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def accelerator_reachable(timeout_s: float = 120.0) -> str | None:
+    """Probe default-backend device init in a BOUNDED subprocess; returns
+    None when healthy, else a short failure description. The axon TPU tunnel
+    can hang ``jax.devices()`` indefinitely when unhealthy (observed
+    2026-07-30/31: even device enumeration never returns, and the plugin's
+    discovery also defeats a plain ``JAX_PLATFORMS=cpu`` env var); a hang
+    inside this process could not be recovered, so the probe must be a
+    child we can kill. Shared by ``bench.py`` and ``__graft_entry__``."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"device init hung >{timeout_s:.0f}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or b"").decode(errors="replace").strip()[-200:]
+        return f"device init failed rc={proc.returncode}: {tail}"
+    return None
+
+
 def force_cpu(n_devices: int | None = None) -> None:
     """Force this process onto the CPU backend, optionally with ``n_devices``
     virtual devices (for mesh tests / multichip dryruns).
