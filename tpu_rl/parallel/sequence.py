@@ -32,6 +32,7 @@ the ring.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,20 @@ DATA_AXIS = "data"
 SEQ_AXIS = "seq"
 
 _NEG_INF = -1e30  # finite -inf stand-in: keeps exp()/max() NaN-free
+
+
+def _uniform_block_sizes(blk: int):
+    """BlockSizes with one tile edge everywhere (fwd + both backward kernels).
+    Shared with examples/bench_flash_attention.py so the bench measures the
+    same construction the dispatch uses."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    return BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk,
+        block_q_dkv=blk, block_k_major_dq=blk, block_k_dq=blk,
+        block_q_dq=blk,
+    )
 
 
 def make_sp_mesh(n_data: int, n_seq: int, devices=None) -> Mesh:
@@ -534,6 +549,15 @@ def flash_attention_tpu(
     )
 
     scale = 1.0 / np.sqrt(q.shape[-1])
+    # The library's get_default() is 128 everywhere ("TODO: select better
+    # parameters" upstream) — measured 3x slower than necessary at the
+    # long-context workload shape. On-chip sweep (bench_flash.json, v5e,
+    # B16 T2048 H8 D64 bf16, fwd+bwd ms): 128->44.8, 256->22.2, 512->15.0,
+    # 1024->14.4, 2048->compile failure. 512 is within 4% of the best,
+    # fits VMEM with margin at wider heads, and must divide T, so:
+    # gcd(512, T): largest power-of-two divisor of T capped at 512.
+    blk = math.gcd(512, q.shape[1])
+    bs = _uniform_block_sizes(blk) if blk >= 128 else None
 
     def kernel(q, k, v, seg):
         # our layout (B, T, H, D) -> kernel layout (B, H, T, D)
@@ -544,6 +568,7 @@ def flash_attention_tpu(
             segment_ids=SegmentIds(q=seg32, kv=seg32),
             causal=causal,
             sm_scale=float(scale),
+            block_sizes=bs,
         )
         return o.transpose(0, 2, 1, 3)
 
