@@ -124,6 +124,11 @@ def bench_one(
         shard_chained_batch,
     )
 
+    # Optional: wrap the timed region in a profiler trace (xprof/tensorboard
+    # readable). Popped before Config validation — it is bench plumbing, not
+    # a workload parameter.
+    profile_dir = cfg_kw.pop("profile_dir", None)
+
     cfg = Config.from_dict(cfg_kw)
     family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(0))
     n_vis = len(jax.devices())
@@ -154,13 +159,22 @@ def bench_one(
     if metrics is not None:
         _sync(metrics)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = pstep(state, batch, key)
-    # The chain is sequential (state feeds state), so one end-of-chain data
-    # readback accounts for every update in the timed region.
-    _sync(metrics)
-    dt = time.perf_counter() - t0
+    if profile_dir is not None:
+        jax.profiler.start_trace(profile_dir)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = pstep(state, batch, key)
+        # The chain is sequential (state feeds state), so one end-of-chain
+        # data readback accounts for every update in the timed region.
+        _sync(metrics)
+        dt = time.perf_counter() - t0
+    finally:
+        # finally: an exception mid-loop must still flush the trace (and
+        # must not leave the profiler running to poison later rows —
+        # run_all catches per-row exceptions and keeps going).
+        if profile_dir is not None:
+            jax.profiler.stop_trace()
 
     transitions = cfg.batch_size * cfg.seq_len
     updates = iters * chain
@@ -255,9 +269,11 @@ WORKLOADS: list[tuple[str, dict, int, int, int]] = [
         3, 20, 1,
     ),
     # Pallas TPU fused-attention kernel (parallel/sequence.py
-    # flash_attention_tpu) at the same 2x batch the blockwise row buys: the
-    # kernel keeps blockwise's O(T) memory without its jnp-level recompute
-    # overhead, so this row should dominate both transformer rows above.
+    # flash_attention_tpu) at the same 2x batch the blockwise row buys. With
+    # the measured BlockSizes (gcd(512,T) uniform tiles — bench_flash.json:
+    # op-level fwd+bwd 15.0 ms vs 31.6 blockwise / 44.8 library-default
+    # tiles), the kernel keeps blockwise's O(T) memory AND beats full
+    # attention's arithmetic, so this row should dominate both above.
     (
         "PPO-transformer@longctx-flash",
         dict(
@@ -267,6 +283,20 @@ WORKLOADS: list[tuple[str, dict, int, int, int]] = [
             n_layers=4, obs_shape=(64,), action_space=8,
         ),
         3, 20, 1,
+    ),
+    # 2x batch again: the kernel's O(T) residuals leave HBM headroom full
+    # attention can't touch (its (B,H,T,T) scores would be ~8 GB here), and
+    # the larger per-dispatch program amortizes layer-boundary overheads —
+    # the MFU-maximizing single-chip long-context configuration.
+    (
+        "PPO-transformer@longctx-flash-b32",
+        dict(
+            algo="PPO", model="transformer", compute_dtype="bfloat16",
+            attention_impl="flash",
+            batch_size=32, seq_len=2048, hidden_size=512, n_heads=8,
+            n_layers=4, obs_shape=(64,), action_space=8,
+        ),
+        3, 12, 1,
     ),
 ]
 

@@ -1,51 +1,59 @@
 #!/bin/sh
 # On-chip measurement backlog — run on the TPU host the moment the
-# accelerator is reachable (probe first, everything below hangs otherwise).
-# Step 1 (bench matrix) WAS completed in round 4's 03:45-04:10 UTC tunnel
-# window (RUN_TPU_r04.md); steps 2-3 remain pending — the tunnel died again
-# before they ran. Note the tunnel's per-dispatch RTT when it returned was
-# ~3-5 ms (vs ~0.5 ms round 3): bench.py's @ref rows now chain 16 updates
-# per dispatch to amortize it; bench_lstm_kernel.py timings below are
-# per-dispatch and will carry that RTT as a constant additive floor on both
-# kernel and scan rows (ratios stay meaningful).
+# accelerator is reachable (probe first, everything below hangs otherwise):
 #
 #   timeout 90 python -c "import jax; print(jax.devices())"
 #
-# Each step writes its committed artifact; nothing here overwrites an
-# on-chip record with fallback numbers (bench.py routes CPU runs to
-# bench_results.cpu.json by itself).
+# Round-4 tunnel windows so far: 03:45-04:10 UTC (full matrix, chained @ref
+# methodology) and 16:10-16:21 UTC (matrix re-run at lower RTT — IMPALA@ref
+# 5.32M t/s — plus the LSTM kernel re-record and the flash-attention
+# BlockSizes sweep, bench_flash.json). The tunnel died before the items
+# below ran. Each step writes its committed artifact; nothing here
+# overwrites an on-chip record with fallback numbers (bench.py routes CPU
+# runs to bench_results.*.json variants by itself).
 set -ex
 cd "$(dirname "$0")/.."
 
-# 1. Full learner matrix -> bench_results.json. Run 4 of round 4 added the
-#    PPO-transformer@longctx-flash row (Pallas TPU fused-attention kernel,
-#    NEVER yet executed on a real chip — the CPU tests only pin its masking
-#    spec); if it errors, the row records the error without aborting the
-#    matrix, and the committed table keeps the other rows.
-python bench.py
+# 1. Re-measure the longctx-flash train-step row with the TUNED BlockSizes
+#    (gcd(512,T) uniform tiles, tpu_rl/parallel/sequence.py): the op-level
+#    sweep has fwd+bwd 3.1x faster than the library-default tiles that made
+#    the committed matrix row lose to blockwise (190.7 vs 136.2 ms/step).
+#    Update the row in bench_results.json if it confirms.
+PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import json
+import bench
+row = bench.bench_one(
+    "PPO-transformer@longctx-flash",
+    dict(algo="PPO", model="transformer", compute_dtype="bfloat16",
+         attention_impl="flash", batch_size=16, seq_len=2048,
+         hidden_size=512, n_heads=8, n_layers=4, obs_shape=(64,),
+         action_space=8),
+    3, 20,
+)
+print(json.dumps(row))
+EOF
 
-# 2. LSTM kernel-vs-scan -> bench_lstm_kernel.json. The dispatch is now
-#    measured-win-only; verify no row has auto_regression > 1.0 (the
-#    "force" mode times the raw kernel, including the fused backward at
-#    multi-tile shapes, which the old bench silently measured as
-#    kernel-fwd + scan-bwd).
-PYTHONPATH=. python examples/bench_lstm_kernel.py
+# 2. Re-record bench_flash.json: the committed sweep's "full" fwd_ms row is
+#    warmup-contaminated (annotated in the artifact); the script now forces
+#    a post-warmup sync. (Keep /root/.axon_site on PYTHONPATH or the TPU
+#    plugin never registers and the row silently re-records on CPU.)
+PYTHONPATH=/root/repo:/root/.axon_site python examples/bench_flash_attention.py
 
 # 3. Long-context transformer profile (VERDICT r3 #6): step-level trace to
 #    attribute the remaining gap to attention vs FF vs data movement.
-#    View with tensorboard/xprof; summarize findings in README.
-PYTHONPATH=. python - <<'EOF'
-import jax
+#    bench_one pops profile_dir and wraps the timed loop in
+#    jax.profiler.start_trace/stop_trace. View with tensorboard/xprof;
+#    summarize findings in README.
+PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import json
 import bench
 row = bench.bench_one(
-    "PPO-transformer@longctx-blockwise",
-    dict(
-        algo="PPO", model="transformer", compute_dtype="bfloat16",
-        attention_impl="blockwise", batch_size=16, seq_len=2048,
-        hidden_size=512, n_heads=8, n_layers=4, obs_shape=(64,),
-        action_space=8, profile_dir="/tmp/tpu_rl_longctx_trace",
-    ),
-    3, 20,
+    "PPO-transformer@longctx-flash-profiled",
+    dict(algo="PPO", model="transformer", compute_dtype="bfloat16",
+         attention_impl="flash", batch_size=16, seq_len=2048,
+         hidden_size=512, n_heads=8, n_layers=4, obs_shape=(64,),
+         action_space=8, profile_dir="/tmp/tpu_rl_longctx_trace"),
+    3, 10,
 )
-print(row)
+print(json.dumps(row))
 EOF

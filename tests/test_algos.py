@@ -162,3 +162,29 @@ def test_sac_alpha_autotunes():
     s1, m = jax.jit(train_step)(state, batch, jax.random.PRNGKey(4))
     assert float(jnp.abs(s1.log_alpha - state.log_alpha)) > 0
     assert float(m["alpha"]) > 0
+
+
+def test_sac_reference_alpha_parity_mode():
+    """Config.sac_reference_alpha reproduces the reference temperature
+    controller exactly: target = +action_space and the reference loss sign
+    (/root/reference/agents/learner_module/sac/learning.py:66-74,
+    agents/learner.py:363-365). Its feedback is unconditionally downward —
+    E[log pi] + |A| > 0 for any policy, so alpha must DECAY on every update
+    (the measured pathology the default controller fixes; BASELINE.md)."""
+    cfg = small_config(algo="SAC", sac_reference_alpha=True)
+    spec = get_algo("SAC")
+    fam, state, train_step = spec.build(cfg, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, fam)
+    step = jax.jit(train_step)
+    s, key = state, jax.random.PRNGKey(4)
+    for _ in range(3):
+        key, k = jax.random.split(key)
+        s, m = step(s, batch, k)
+    assert float(s.log_alpha) < float(state.log_alpha), (
+        "reference-parity alpha must decay unconditionally"
+    )
+    # The parity loss itself is +alpha*(ent_neg + |A|), strictly positive
+    # for any policy (ent_neg >= -log|A| > -|A|) — pin that too, so a
+    # future sign/target regression in the gate is caught even if alpha
+    # still happens to move down.
+    assert float(m["loss_alpha"]) > 0

@@ -45,7 +45,11 @@ def make_train_step(cfg: Config, family: ModelFamily):
     # equilibrates. Standard practice instead: continuous -dim(A)
     # (Haarnoja et al. 2018), discrete 0.98*log|A| (Christodoulou 2019).
     # cfg.target_entropy overrides the rule when set.
-    if cfg.target_entropy is not None:
+    if cfg.sac_reference_alpha:
+        # Strict parity (Config.sac_reference_alpha): the reference's exact
+        # rule, +action_space for both variants (``learner.py:363-365``).
+        target_entropy = float(cfg.action_space)
+    elif cfg.target_entropy is not None:
         target_entropy = float(cfg.target_entropy)
     elif continuous:
         target_entropy = -float(cfg.action_space)
@@ -102,8 +106,12 @@ def make_train_step(cfg: Config, family: ModelFamily):
         # collapse, greedy as low as -69). Standard SAC minimizes
         # -alpha*(logpi + target): deficit -> alpha grows -> more entropy
         # pressure; surplus -> alpha shrinks.
+        ref_sign = 1.0 if cfg.sac_reference_alpha else -1.0
+
         def alpha_loss_fn(log_alpha):
-            return -jnp.mean(jnp.exp(log_alpha) * (sg(ent_neg) + target_entropy))
+            return ref_sign * jnp.mean(
+                jnp.exp(log_alpha) * (sg(ent_neg) + target_entropy)
+            )
 
         loss_alpha, g_alpha = jax.value_and_grad(alpha_loss_fn)(state.log_alpha)
         up, alpha_opt = opt_alpha.update(g_alpha, state.alpha_opt, state.log_alpha)

@@ -94,6 +94,17 @@ class Config:
     # (-dim(A) continuous, 0.98*log|A| discrete — see algos/sac.py for the
     # documented divergence from the reference's +action_space).
     target_entropy: float | None = None
+    # Strict-parity mode for the SAC temperature controller: reproduce the
+    # reference's alpha update EXACTLY — target_entropy = +action_space and
+    # loss_alpha = +mean(alpha * (E[log pi] + target))
+    # (/root/reference/agents/learner_module/sac/learning.py:66-74,
+    # agents/learner.py:363-365). That feedback runs backwards (alpha decays
+    # toward 0 unconditionally, since E[log pi] + |A| > 0 always), which is
+    # why the default here is the corrected controller; the flag exists so
+    # reference temperature behavior is reproducible for audit, same
+    # pattern as zero_window_carry/std_floor (parity by default elsewhere,
+    # gated divergence here because the fix is load-bearing for learning).
+    sac_reference_alpha: bool = False
 
     # V-trace clipping (reference hard-codes rho in [0.1, 0.8], c_bar = 1.0,
     # /root/reference/agents/learner_module/compute_loss.py:29-43)
