@@ -57,3 +57,20 @@ row = bench.bench_one(
 )
 print(json.dumps(row))
 EOF
+
+# 4. V-MPO anomaly: 1.20 ms/update chained vs 0.12-0.26 for every sibling
+#    algorithm at the same quantum (16:10 window matrix). CPU HLO census
+#    shows no sort (top_k lowers clean) — needs an on-chip trace to
+#    attribute (suspects: top_k lowering on TPU, the three dual-optimizer
+#    update chains, gather/take_along_axis layout).
+PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import json
+import bench
+row = bench.bench_one(
+    "V-MPO@ref-profiled",
+    dict(algo="V-MPO", obs_shape=(4,), action_space=2, batch_size=128,
+         seq_len=5, hidden_size=64, profile_dir="/tmp/tpu_rl_vmpo_trace"),
+    5, 20, 16,
+)
+print(json.dumps(row))
+EOF
