@@ -3,6 +3,7 @@ equivalent (up to float tolerance) to single-device attention, for outputs
 and gradients, on the virtual 8-device CPU mesh (SURVEY.md §4 pattern)."""
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -302,6 +303,26 @@ class TestFlashImpl:
         want = full_attention(q, k, v, pos, seg, causal=True)
         got = flash_attention_tpu(q, k, v, pos, seg, causal=True)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_block_size_selection(self):
+        """The measured-win tile rule (bench_flash.json sweep), asserted on
+        the PRODUCTION selector the dispatch calls: uniform gcd(512, T)
+        tiles when >= 128 (the kernel's minimum), library defaults (None)
+        otherwise. Every selected edge must divide T (grid exactness)."""
+        from tpu_rl.parallel.sequence import (
+            _select_block_size,
+            _uniform_block_sizes,
+        )
+
+        for T, want in [(2048, 512), (512, 512), (384, 128), (256, 256),
+                        (128, 128), (1536, 512)]:
+            blk = _select_block_size(T)
+            assert blk == want and T % blk == 0, (T, blk, want)
+            bs = _uniform_block_sizes(blk)
+            assert bs.block_q == bs.block_k == bs.block_q_dq == blk
+            assert bs.has_backward_blocks  # fused bwd kernels get tiles too
+        for T in (100, 64, 96):  # < 128 or not 128-divisible -> None path
+            assert _select_block_size(T) is None
 
     def test_transformer_flash_config_builds_and_matches_full(self, rng):
         from tests.conftest import small_config

@@ -45,6 +45,15 @@ SEQ_AXIS = "seq"
 _NEG_INF = -1e30  # finite -inf stand-in: keeps exp()/max() NaN-free
 
 
+def _select_block_size(T: int) -> int | None:
+    """Tile edge for the Pallas flash kernel at sequence length T, by the
+    measured-win rule from the on-chip sweep (bench_flash.json): gcd(512, T)
+    — the largest power-of-two divisor of T capped at 512 — when that is at
+    least the kernel's 128 minimum; None = use library defaults."""
+    blk = math.gcd(512, T)
+    return blk if blk >= 128 else None
+
+
 def _uniform_block_sizes(blk: int):
     """BlockSizes with one tile edge everywhere (fwd + both backward kernels).
     Shared with examples/bench_flash_attention.py so the bench measures the
@@ -555,9 +564,8 @@ def flash_attention_tpu(
     # B16 T2048 H8 D64 bf16, fwd+bwd ms): 128->44.8, 256->22.2, 512->15.0,
     # 1024->14.4, 2048->compile failure. 512 is within 4% of the best,
     # fits VMEM with margin at wider heads, and must divide T, so:
-    # gcd(512, T): largest power-of-two divisor of T capped at 512.
-    blk = math.gcd(512, q.shape[1])
-    bs = _uniform_block_sizes(blk) if blk >= 128 else None
+    blk = _select_block_size(q.shape[1])
+    bs = _uniform_block_sizes(blk) if blk is not None else None
 
     def kernel(q, k, v, seg):
         # our layout (B, T, H, D) -> kernel layout (B, H, T, D)
