@@ -59,10 +59,11 @@ print(json.dumps(row))
 EOF
 
 # 4. V-MPO anomaly: 1.20 ms/update chained vs 0.12-0.26 for every sibling
-#    algorithm at the same quantum (16:10 window matrix). CPU HLO census
-#    shows no sort (top_k lowers clean) — needs an on-chip trace to
-#    attribute (suspects: top_k lowering on TPU, the three dual-optimizer
-#    update chains, gather/take_along_axis layout).
+#    algorithm at the same quantum (16:10 window matrix). TPU-specific:
+#    on CPU the same chained programs measure V-MPO at only 1.4x IMPALA
+#    (8.3 vs 6.0 ms/update), and the CPU HLO census shows no sort (top_k
+#    lowers clean) — so suspects are the TPU lowerings of top_k and
+#    take_along_axis (gather), which the trace will name directly.
 PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
 import json
 import bench
