@@ -38,3 +38,100 @@ def test_force_cpu_wins_after_backend_init():
     )
     assert r.returncode == 0, r.stderr
     assert "FORCED_OK" in r.stdout
+
+
+def test_ensure_accelerator_or_cpu_degrades_on_probe_failure(monkeypatch):
+    """learner_device="auto" on a dead tunnel must degrade to CPU (loudly)
+    instead of hanging: role_entry calls this for the accelerator-owning
+    child (tpu_rl/utils/errlog.py)."""
+    from tpu_rl.utils import platform
+
+    calls = []
+    monkeypatch.setattr(platform, "accelerator_reachable",
+                        lambda timeout_s=120.0: "device init hung >90s")
+    monkeypatch.setattr(platform, "force_cpu",
+                        lambda n_devices=None: calls.append("force_cpu"))
+    failure = platform.ensure_accelerator_or_cpu("learner")
+    assert failure == "device init hung >90s"
+    assert calls == ["force_cpu"]
+
+
+def test_ensure_accelerator_or_cpu_no_touch_when_healthy(monkeypatch):
+    from tpu_rl.utils import platform
+
+    calls = []
+    monkeypatch.setattr(platform, "accelerator_reachable",
+                        lambda timeout_s=120.0: None)
+    monkeypatch.setattr(platform, "force_cpu",
+                        lambda n_devices=None: calls.append("force_cpu"))
+    assert platform.ensure_accelerator_or_cpu("learner") is None
+    assert calls == []
+
+
+def test_role_entry_probe_flag(monkeypatch):
+    """role_entry probes only when probe_accelerator=True (supervisor sets
+    it on restarts of the accelerator-owning child)."""
+    from tpu_rl.utils import errlog, platform
+
+    calls = []
+    monkeypatch.setattr(
+        platform, "accelerator_reachable",
+        lambda timeout_s=120.0: calls.append(("probe", timeout_s)) or "down",
+    )
+    monkeypatch.setattr(
+        platform, "force_cpu", lambda n_devices=None: calls.append(("cpu",))
+    )
+    ran = []
+    errlog.role_entry(lambda: ran.append(1), "learner", "/tmp/logs")
+    assert ran == [1] and calls == []  # first start: no probe
+    errlog.role_entry(
+        lambda: ran.append(2), "learner", "/tmp/logs", probe_accelerator=True
+    )
+    assert ran == [1, 2]
+    assert calls == [("probe", 60.0), ("cpu",)]  # bounded probe, degraded
+
+
+def test_supervisor_restart_sets_probe_flag():
+    """Supervisor._start adds probe_accelerator=True to a non-cpu_only
+    child's target on restarts (and never on first start)."""
+    import functools
+
+    from tpu_rl.runtime.runner import Child, Supervisor
+
+    captured = {}
+
+    class _Proc:
+        def __init__(self, target=None, args=(), name=None, daemon=True):
+            captured[name] = target
+        def start(self):
+            pass
+
+    class _Ctx:
+        Process = _Proc
+
+    sup = Supervisor.__new__(Supervisor)
+    sup.ctx = _Ctx()
+
+    class _HB:
+        value = 0.0
+
+    def tgt(**kw):
+        pass
+
+    base = functools.partial(tgt)
+    for name, cpu_only, restarts, want_flag in [
+        ("learner-first", False, 0, False),
+        ("learner-restart", False, 1, True),
+        ("worker-restart", True, 1, False),
+    ]:
+        child = Child(
+            name=name, target=base, args=(), proc=None, heartbeat=_HB(),
+            cpu_only=cpu_only, restarts=restarts,
+        )
+        sup._start(child)
+        got = captured[name]
+        flagged = (
+            isinstance(got, functools.partial)
+            and got.keywords.get("probe_accelerator") is True
+        )
+        assert flagged == want_flag, (name, got)

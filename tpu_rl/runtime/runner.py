@@ -110,9 +110,19 @@ class Supervisor:
 
     def _start(self, child: Child) -> None:
         env = {"JAX_PLATFORMS": "cpu"} if child.cpu_only else {}
+        target = child.target
+        if not child.cpu_only and child.restarts > 0:
+            # An accelerator-owning child being RESTARTED was most likely
+            # killed for silence — and the axon tunnel's failure mode is a
+            # silent indefinite hang in device init. Have the replacement
+            # probe the accelerator (bounded) and degrade to CPU if it is
+            # unreachable, instead of burning the whole restart budget
+            # against a dead tunnel. First starts skip the probe: no
+            # healthy-path overhead (role_entry docstring).
+            target = functools.partial(target, probe_accelerator=True)
         with _child_env(**env):
             child.proc = self.ctx.Process(
-                target=child.target, args=child.args, name=child.name, daemon=True
+                target=target, args=child.args, name=child.name, daemon=True
             )
             child.heartbeat.value = time.time()
             child.started_at = time.time()

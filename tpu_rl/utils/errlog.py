@@ -25,7 +25,12 @@ def save_error_log(role: str, exc: BaseException, log_root: str = "logs") -> str
 
 
 def role_entry(
-    target, role: str, log_root: str, *args, cpu_only: bool = False
+    target,
+    role: str,
+    log_root: str,
+    *args,
+    cpu_only: bool = False,
+    probe_accelerator: bool = False,
 ) -> None:
     """mp.Process target wrapper: run ``target(*args)``; on exception, write
     the crash log and re-raise (the supervisor sees a nonzero exit).
@@ -34,11 +39,24 @@ def role_entry(
     runs any jax op — the ``JAX_PLATFORMS`` env pin is ignored by the TPU
     plugin in this environment, and a worker that opens libtpu deadlocks the
     learner on the libtpu lockfile (see ``utils.platform``).
+
+    ``probe_accelerator`` (the supervisor sets it on RESTARTS of the
+    accelerator-owning child only): bounded device-init probe, degrading to
+    the CPU backend when the accelerator is unreachable. First start skips
+    the probe — zero overhead when the chip is healthy; if the tunnel is
+    hung, the first start blocks silently, the supervisor's restart-on-
+    silence replaces it, and the replacement probes (60 s, inside the
+    silence budget) and lands on CPU instead of looping the restart budget
+    away against the same dead tunnel.
     """
     if cpu_only:
         from tpu_rl.utils.platform import force_cpu
 
         force_cpu()
+    elif probe_accelerator:
+        from tpu_rl.utils.platform import ensure_accelerator_or_cpu
+
+        ensure_accelerator_or_cpu(role, timeout_s=60.0)
     try:
         target(*args)
     except BaseException as exc:  # noqa: BLE001 — log everything, incl. SystemExit

@@ -57,6 +57,31 @@ def accelerator_reachable(timeout_s: float = 120.0) -> str | None:
     return None
 
 
+def ensure_accelerator_or_cpu(
+    role: str = "learner", timeout_s: float = 120.0
+) -> str | None:
+    """Bounded accelerator probe for a process that WANTS the accelerator
+    (``learner_device="auto"``): when device init would hang or fail —
+    the axon tunnel's observed failure mode is an indefinite hang, which a
+    supervisor would otherwise turn into a futile restart loop — force the
+    CPU backend and return the failure description (None = accelerator
+    healthy, backend untouched). The degradation is printed so the operator
+    sees WHY the run is on CPU. ``timeout_s`` lets a supervised child size
+    the probe under its supervisor's silence budget."""
+    failure = accelerator_reachable(timeout_s)
+    if failure is not None:
+        import sys
+
+        print(
+            f"[{role}] accelerator unreachable ({failure}); "
+            "degrading to the CPU backend",
+            file=sys.stderr,
+            flush=True,
+        )
+        force_cpu()
+    return failure
+
+
 def force_cpu(n_devices: int | None = None) -> None:
     """Force this process onto the CPU backend, optionally with ``n_devices``
     virtual devices (for mesh tests / multichip dryruns).
