@@ -113,7 +113,11 @@ def bench_one(
     update and would report tunnel latency as learner throughput. Chaining
     amortizes dispatch to RTT/K per update, so the row measures the chip's
     sustainable update rate — what the reference's local-GPU timer measures
-    (``/root/reference/utils/utils.py:174-189``)."""
+    (``/root/reference/utils/utils.py:174-189``). This is the same dispatch
+    path production takes: ``LearnerService`` runs chained programs when
+    ``Config.learner_chain > 1`` (equivalence to sequential updates through
+    the real shm feed is asserted by
+    ``tests/test_runtime.py::test_learner_chain_matches_sequential_through_shm``)."""
     from tpu_rl.algos.registry import get_algo
     from tpu_rl.config import Config
     from tpu_rl.parallel import (
@@ -125,8 +129,9 @@ def bench_one(
     )
 
     # Optional: wrap the timed region in a profiler trace (xprof/tensorboard
-    # readable). Popped before Config validation — it is bench plumbing, not
-    # a workload parameter.
+    # readable). Popped from a copy before Config validation — it is bench
+    # plumbing, not a workload parameter, and callers reuse workload dicts.
+    cfg_kw = dict(cfg_kw)
     profile_dir = cfg_kw.pop("profile_dir", None)
 
     cfg = Config.from_dict(cfg_kw)
@@ -333,6 +338,7 @@ def run_all(out_path: str | None = None) -> dict:
         "n_devices": len(jax.devices()),
         "peak_bf16_flops_per_chip": device_peak_flops(),
         "reference_baseline_tps": REFERENCE_BASELINE_TPS,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "rows": rows,
     }
     with open(out_path, "w") as f:
@@ -382,12 +388,66 @@ def _accelerator_reachable(timeout_s: float = 120.0) -> str | None:
     return accelerator_reachable(timeout_s)
 
 
+def last_good_onchip(path: str | None = None) -> dict | None:
+    """Summary of the newest *committed on-chip* matrix, for embedding in
+    the headline when the accelerator is unreachable at capture time.
+
+    Rounds 3 and 4 both shipped CPU-only ``BENCH_r0N.json`` because the
+    tunnel happened to be down at the driver's capture moment, while the
+    real chip matrix sat in ``bench_results.json`` — this carries that
+    evidence into the headline (clearly marked stale) instead of losing it.
+    Returns None unless the file exists and records a non-CPU device."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    if path is None:
+        path = os.path.join(here, "bench_results.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    kind = str(rec.get("device_kind", ""))
+    if not kind or kind.lower().startswith("cpu"):
+        return None
+    recorded = rec.get("recorded_at")
+    if recorded is None:
+        # matrices committed before the recorded_at field existed: the
+        # file's last git commit time bounds the capture time
+        import subprocess
+
+        try:
+            proc = subprocess.run(
+                ["git", "log", "-1", "--format=%cI", "--",
+                 os.path.basename(path)],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(path) or here,
+            )
+            recorded = proc.stdout.strip() or None
+        except Exception:
+            recorded = None
+    rows = [r for r in rec.get("rows", []) if "tps" in r]
+    head = next((r for r in rows if r.get("name") == "IMPALA@ref"), None)
+    return {
+        "recorded_at": recorded,
+        "device_kind": kind,
+        "headline_tps": head["tps"] if head else None,
+        "vs_baseline": (
+            round(head["tps"] / REFERENCE_BASELINE_TPS, 2) if head else None
+        ),
+        "rows": [
+            {k: r[k] for k in
+             ("name", "step_ms", "tps", "mfu", "steps_per_call") if k in r}
+            for r in rows
+        ],
+    }
+
+
 if __name__ == "__main__":
-    failure = (
-        None
-        if os.environ.get("TPU_RL_BENCH_CHILD")
-        else _accelerator_reachable()
-    )
+    if os.environ.get("TPU_RL_BENCH_CHILD"):
+        failure = None
+    elif os.environ.get("TPU_RL_BENCH_SIMULATE_OUTAGE"):
+        failure = "simulated outage (TPU_RL_BENCH_SIMULATE_OUTAGE)"
+    else:
+        failure = _accelerator_reachable()
     if failure is None:
         if os.environ.get("TPU_RL_BENCH_LIGHT"):
             # CPU fallback: the axon TPU plugin ignores JAX_PLATFORMS=cpu
@@ -421,4 +481,12 @@ if __name__ == "__main__":
         out["note"] = (
             f"accelerator unreachable ({failure}); CPU-backend fallback numbers"
         )
+        # Outage-proofing (VERDICT r4 #3): carry the newest committed
+        # on-chip matrix in the same headline line, marked stale, so the
+        # round artifact keeps chip evidence even when the tunnel is down
+        # at capture time.
+        stale = last_good_onchip()
+        if stale is not None:
+            out["stale_onchip"] = True
+            out["last_onchip"] = stale
         print(json.dumps(out))

@@ -154,6 +154,45 @@ def test_vmpo_stays_finite_under_extreme_ratios():
     assert float(state.params["log_eta"]) >= np.log(1e-6) - 1e-6
 
 
+def test_vmpo_mask_selection_matches_topk_gather():
+    """The threshold-mask top-half (``vmpo.top_half_mask``, no gather — the
+    round-5 TPU-lowering fix) must give bit-identical psi-weighted policy
+    loss and masked-logsumexp to the topk+take_along_axis formulation it
+    replaced (reference semantics: ``v_mpo/learning.py:60-74``)."""
+    import math
+
+    from tpu_rl.algos.vmpo import top_half_mask
+
+    key = jax.random.PRNGKey(11)
+    B, T = 32, 7
+    adv = jax.random.normal(key, (B, T, 1))
+    logp = -jnp.abs(jax.random.normal(jax.random.fold_in(key, 1), (B, T, 1)))
+    k = math.ceil(B / 2)
+    eta = 0.37
+
+    # old formulation: torch.topk(dim=0) + gather
+    xm = jnp.moveaxis(adv, 0, -1)
+    vals, idx = jax.lax.top_k(xm, k)
+    top_gae = jnp.moveaxis(vals, -1, 0)
+    top_idx = jnp.moveaxis(idx, -1, 0)
+    ratio_old = top_gae / (eta + 1e-7)
+    top_logp = jnp.take_along_axis(logp, top_idx, axis=0)
+    psi_old = jax.nn.softmax(ratio_old.reshape(-1)).reshape(ratio_old.shape)
+    loss_old = -jnp.sum(psi_old * top_logp)
+    lse_old = jax.nn.logsumexp(ratio_old)
+
+    # new formulation: threshold mask, no gather
+    mask = top_half_mask(adv, k)
+    assert float(jnp.sum(mask)) == k * T  # exactly k selected per timestep
+    ratio = adv / (eta + 1e-7)
+    lse_new = jax.nn.logsumexp(jnp.where(mask > 0, ratio, -jnp.inf))
+    psi = mask * jnp.exp(ratio - lse_new)
+    loss_new = -jnp.sum(psi * jnp.where(mask > 0, logp, 0.0))
+
+    np.testing.assert_allclose(float(lse_new), float(lse_old), rtol=1e-6)
+    np.testing.assert_allclose(float(loss_new), float(loss_old), rtol=1e-6)
+
+
 def test_sac_alpha_autotunes():
     cfg = small_config(algo="SAC")
     spec = get_algo("SAC")

@@ -1,0 +1,73 @@
+"""Headline outage-proofing (VERDICT r4 #3): when the accelerator is
+unreachable at capture time, bench.py must embed the newest committed
+on-chip matrix — marked stale, with its recorded timestamp — alongside the
+CPU fallback numbers, so a tunnel outage can no longer erase chip evidence
+from the round artifact (it did in rounds 3 and 4)."""
+
+import json
+
+import bench
+
+
+def _matrix(device_kind, tps=5_320_000.0, recorded="2026-07-31T16:21:00Z"):
+    return {
+        "device_kind": device_kind,
+        "n_devices": 1,
+        "recorded_at": recorded,
+        "rows": [
+            {"name": "IMPALA@ref", "step_ms": 0.12, "tps": tps,
+             "mfu": None, "steps_per_call": 16},
+            {"name": "IMPALA@wide-lstm", "step_ms": 10.16, "tps": 1_612_000.0,
+             "mfu": 0.22, "steps_per_call": 1},
+            {"name": "broken-row", "error": "OOM"},
+        ],
+    }
+
+
+def test_last_good_onchip_summarizes_tpu_matrix(tmp_path):
+    p = tmp_path / "bench_results.json"
+    p.write_text(json.dumps(_matrix("TPU v5 lite")))
+    got = bench.last_good_onchip(str(p))
+    assert got is not None
+    assert got["device_kind"] == "TPU v5 lite"
+    assert got["recorded_at"] == "2026-07-31T16:21:00Z"
+    assert got["headline_tps"] == 5_320_000.0
+    assert got["vs_baseline"] == round(5_320_000.0 / 600.0, 2)
+    # error rows are dropped; measured rows keep only the summary keys
+    assert [r["name"] for r in got["rows"]] == ["IMPALA@ref", "IMPALA@wide-lstm"]
+    assert set(got["rows"][0]) <= {"name", "step_ms", "tps", "mfu",
+                                   "steps_per_call"}
+
+
+def test_last_good_onchip_rejects_cpu_matrix_and_missing_file(tmp_path):
+    p = tmp_path / "bench_results.json"
+    p.write_text(json.dumps(_matrix("cpu")))
+    assert bench.last_good_onchip(str(p)) is None
+    assert bench.last_good_onchip(str(tmp_path / "nope.json")) is None
+    p.write_text("{not json")
+    assert bench.last_good_onchip(str(p)) is None
+
+
+def test_last_good_onchip_falls_back_to_git_commit_time(tmp_path):
+    """Matrices committed before the recorded_at field: the file's last git
+    commit time (or None outside a repo) bounds the capture time — never a
+    crash."""
+    m = _matrix("TPU v5 lite")
+    del m["recorded_at"]
+    p = tmp_path / "bench_results.json"
+    p.write_text(json.dumps(m))
+    got = bench.last_good_onchip(str(p))  # tmp_path is not a git repo
+    assert got is not None and got["recorded_at"] is None
+
+    # the real committed matrix (pre-field) resolves an actual commit time
+    real = bench.last_good_onchip()
+    if real is not None:  # present in this checkout
+        assert real["recorded_at"] and real["recorded_at"][:3] == "202"
+
+
+def test_committed_matrix_headline_matches_run_tpu_record():
+    """The committed bench_results.json must parse and carry the on-chip
+    IMPALA@ref headline the round-4 record cites."""
+    got = bench.last_good_onchip()
+    assert got is not None, "committed on-chip matrix missing or CPU"
+    assert got["headline_tps"] and got["headline_tps"] > 1e6

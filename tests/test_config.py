@@ -29,6 +29,17 @@ def test_bf16_both_backbones():
         Config.from_dict({"compute_dtype": "float16"})
 
 
+def test_sac_reference_alpha_rejects_explicit_target_entropy():
+    """The parity branch pins target_entropy to +action_space; an explicit
+    target alongside it would be silently ignored — fail fast instead."""
+    with pytest.raises(ValueError, match="sac_reference_alpha"):
+        Config.from_dict(
+            {"algo": "SAC", "sac_reference_alpha": True, "target_entropy": -1.0}
+        )
+    Config.from_dict({"algo": "SAC", "sac_reference_alpha": True})
+    Config.from_dict({"algo": "SAC", "target_entropy": -1.0})
+
+
 def test_sequence_parallel_constraints():
     with pytest.raises(AssertionError):
         Config.from_dict({"mesh_seq": 2, "model": "lstm"})

@@ -3,7 +3,6 @@ equivalent (up to float tolerance) to single-device attention, for outputs
 and gradients, on the virtual 8-device CPU mesh (SURVEY.md §4 pattern)."""
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
@@ -323,6 +322,10 @@ class TestFlashImpl:
             assert bs.has_backward_blocks  # fused bwd kernels get tiles too
         for T in (100, 64, 96):  # < 128 or not 128-divisible -> None path
             assert _select_block_size(T) is None
+        # wide heads: sweep only covered D<=128; defaults past that (the
+        # 512-edge backward tiles would scale VMEM past safe margins)
+        assert _select_block_size(2048, head_dim=128) == 512
+        assert _select_block_size(2048, head_dim=256) is None
 
     def test_transformer_flash_config_builds_and_matches_full(self, rng):
         from tests.conftest import small_config

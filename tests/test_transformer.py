@@ -94,25 +94,34 @@ class TestTransformerUnroll:
 
 
 class TestSequenceParallelTrainStep:
-    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
-    def test_sp_train_step_matches_single_device(self, devices, rng, impl):
-        """Full PPO train step, transformer backbone: (data=2, seq=4) mesh
-        result == single-device result."""
+    @pytest.mark.parametrize(
+        "impl,algo",
+        [("ring", "PPO"), ("ulysses", "PPO"), ("ring", "V-MPO")],
+    )
+    def test_sp_train_step_matches_single_device(self, devices, rng, impl, algo):
+        """Full train step, transformer backbone: (data=2, seq=4) mesh
+        result == single-device result. V-MPO is the sharding-hard case
+        (VERDICT r4 #7): its per-timestep top-half advantage selection
+        reduces over the data-sharded batch axis while the time axis is
+        seq-sharded — both the threshold sort and the global psi softmax
+        must cross the mesh."""
         from tpu_rl.data.layout import BatchLayout
         from tpu_rl.parallel import make_sp_mesh, make_sp_train_step
 
-        cfg = _tf_config(attention_impl=impl, mesh_data=2, mesh_seq=4)
+        cfg = _tf_config(
+            algo=algo, attention_impl=impl, mesh_data=2, mesh_seq=4
+        )
         lay = BatchLayout.from_config(cfg)
         batch = _random_batch(cfg, rng, lay.hx, lay.cx)
         key = jax.random.key(7)
 
         # single device reference (full attention, same params)
         cfg1 = cfg.replace(attention_impl="full", mesh_data=1, mesh_seq=1)
-        _, state1, step1 = get_algo("PPO").build(cfg1, jax.random.key(0))
+        _, state1, step1 = get_algo(algo).build(cfg1, jax.random.key(0))
         s1, m1 = jax.jit(step1)(state1, batch, key)
 
         mesh = make_sp_mesh(2, 4)
-        _, state2, step2 = get_algo("PPO").build(
+        _, state2, step2 = get_algo(algo).build(
             cfg, jax.random.key(0), mesh=mesh
         )
         pstep = make_sp_train_step(step2, mesh, cfg)

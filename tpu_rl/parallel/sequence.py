@@ -45,11 +45,17 @@ SEQ_AXIS = "seq"
 _NEG_INF = -1e30  # finite -inf stand-in: keeps exp()/max() NaN-free
 
 
-def _select_block_size(T: int) -> int | None:
+def _select_block_size(T: int, head_dim: int = 64) -> int | None:
     """Tile edge for the Pallas flash kernel at sequence length T, by the
     measured-win rule from the on-chip sweep (bench_flash.json): gcd(512, T)
     — the largest power-of-two divisor of T capped at 512 — when that is at
-    least the kernel's 128 minimum; None = use library defaults."""
+    least the kernel's 128 minimum; None = use library defaults.
+
+    The sweep covered head_dim 64 (bf16); 512-edge backward tiles scale
+    VMEM linearly with head_dim, so past 128 the override could exceed VMEM
+    where the library defaults still compile — defaults win there."""
+    if head_dim > 128:
+        return None
     blk = math.gcd(512, T)
     return blk if blk >= 128 else None
 
@@ -564,7 +570,7 @@ def flash_attention_tpu(
     # B16 T2048 H8 D64 bf16, fwd+bwd ms): 128->44.8, 256->22.2, 512->15.0,
     # 1024->14.4, 2048->compile failure. 512 is within 4% of the best,
     # fits VMEM with margin at wider heads, and must divide T, so:
-    blk = _select_block_size(q.shape[1])
+    blk = _select_block_size(q.shape[1], head_dim=q.shape[-1])
     bs = _uniform_block_sizes(blk) if blk is not None else None
 
     def kernel(q, k, v, seg):

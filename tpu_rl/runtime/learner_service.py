@@ -35,6 +35,14 @@ from tpu_rl.utils.metrics import LearnerLogger, make_writer
 from tpu_rl.utils.timer import ExecutionTimer
 
 
+def _crossed(prev: int, cur: int, interval: int) -> bool:
+    """Did the counter cross a multiple of ``interval`` moving prev -> cur?
+    Equivalent to ``cur % interval == 0`` when steps are 1; with chained
+    dispatch the counter advances K per iteration and plain modulo would
+    skip firings whose multiple falls inside the jump."""
+    return cur // interval > prev // interval
+
+
 class LearnerService:
     def __init__(
         self,
@@ -104,6 +112,8 @@ class LearnerService:
         # the raw train step with the post-switch cfg and must re-apply the
         # same mesh/jit wrapping.
         self._place_global = None
+        chain = max(1, cfg.learner_chain)
+        self._chain_mesh = None
         if mesh is not None:  # built above iff cfg.mesh_seq > 1
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -117,14 +127,19 @@ class LearnerService:
             self._setup_multihost_feed(
                 NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
             )
-        elif cfg.mesh_data > 1:
+        elif cfg.mesh_data > 1 or chain > 1:
+            # chain > 1 rides the same GSPMD wrapper even on one device
+            # (make_mesh(1)): the chained lax.scan program is what
+            # amortizes per-dispatch overhead, mesh width is orthogonal.
             from tpu_rl.parallel.dp import make_parallel_train_step, replicate
             from tpu_rl.parallel.mesh import batch_sharding, make_mesh
 
             mesh = make_mesh(cfg.mesh_data)
+            if chain > 1:
+                self._chain_mesh = mesh
 
             def _wrap(step, wcfg):
-                return make_parallel_train_step(step, mesh, wcfg)
+                return make_parallel_train_step(step, mesh, wcfg, chain=chain)
 
             state = replicate(state, mesh)
             self._setup_multihost_feed(batch_sharding(mesh))
@@ -159,22 +174,46 @@ class LearnerService:
         pub = Pub("*", self.model_port, bind=True, hwm=MODEL_HWM)
         writer = make_writer(cfg.result_dir)
         logger = LearnerLogger(writer, cfg.algo)
-        timer = ExecutionTimer(num_transition=cfg.seq_len * cfg.batch_size)
+        # One timed window per DISPATCH; a chained dispatch carries
+        # chain x (seq x batch) transitions.
+        timer = ExecutionTimer(
+            num_transition=cfg.seq_len * cfg.batch_size * chain
+        )
         key = jax.random.key(self.seed + 1)
 
         # First broadcast so workers act with the resumed/initial policy
         # rather than their own random init.
         self._publish(pub, state)
 
+        if (
+            self.max_updates is not None
+            and chain > 1
+            and self.max_updates % chain
+        ):
+            print(
+                f"[learner] max_updates {self.max_updates} is not a multiple "
+                f"of learner_chain {chain}; budget rounds DOWN to "
+                f"{self.max_updates // chain * chain} updates", flush=True,
+            )
         idx = start_idx
         profiling = False
+        pending: list[dict] = []
+        batching_secs = 0.0
         try:
             while not self._stopped():
-                if self.max_updates is not None and idx - start_idx >= self.max_updates:
+                # A dispatch always advances the counter by `chain`, so stop
+                # before one that would exceed the budget (never overshoot;
+                # non-divisible budgets round down, warned above).
+                if (
+                    self.max_updates is not None
+                    and idx - start_idx + chain > self.max_updates
+                ):
                     break
                 # Idle polls stay OUTSIDE the throughput timer: an empty-store
                 # iteration processes zero transitions and must not inflate
-                # the learner-FPS window.
+                # the learner-FPS window. Per-consume spans are summed into
+                # batching_secs so a chained dispatch reports ALL K shm
+                # copies, not just the last one.
                 t_sample = time.perf_counter()
                 raw = self._next_batch(store, rng)
                 if raw is None:
@@ -182,15 +221,26 @@ class LearnerService:
                         self.heartbeat.value = time.time()
                     time.sleep(0.002)
                     continue
+                batching_secs += time.perf_counter() - t_sample
+                pending.append(raw)
+                if len(pending) < chain:
+                    # keep consuming toward a full chained dispatch
+                    # (stores copy on read, so held batches are stable);
+                    # heartbeat so a slowly-filling chain can't look dead
+                    if self.heartbeat is not None:
+                        self.heartbeat.value = time.time()
+                    continue
                 with timer.timer("learner-throughput", check_throughput=True):
-                    batch = self._to_batch(raw)
-                    timer.record(
-                        "learner-batching-time", time.perf_counter() - t_sample
-                    )
+                    t_assemble = time.perf_counter()
+                    batch = self._assemble(pending)
+                    pending = []
+                    batching_secs += time.perf_counter() - t_assemble
+                    timer.record("learner-batching-time", batching_secs)
+                    batching_secs = 0.0
                     with timer.timer("learner-step-time"):
                         key, sub_key = jax.random.split(key)
                         state, metrics = train_step(state, batch, sub_key)
-                idx += 1
+                prev_idx, idx = idx, idx + chain
 
                 progress = idx if anneal_absolute else idx - start_idx
                 if anneal_at is not None and progress >= anneal_at:
@@ -222,15 +272,17 @@ class LearnerService:
                         jax.block_until_ready(metrics)
                         jax.profiler.stop_trace()
                         profiling = False
-                if idx % self.publish_interval == 0:
+                if _crossed(prev_idx, idx, self.publish_interval):
                     self._publish(pub, state)
-                if idx % cfg.loss_log_interval == 0:
+                if _crossed(prev_idx, idx, cfg.loss_log_interval):
                     jax.block_until_ready(metrics)
                     logger.log_losses(idx, {k: float(v) for k, v in metrics.items()})
                     logger.log_timers(idx, timer)
                     self._log_fleet_stat(logger)
                     logger.flush()
-                if ckpt is not None and idx % cfg.model_save_interval == 0:
+                if ckpt is not None and _crossed(
+                    prev_idx, idx, cfg.model_save_interval
+                ):
                     ckpt.save(state, idx)
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
@@ -262,6 +314,19 @@ class LearnerService:
             writer.close()
 
     # ------------------------------------------------------------- batching
+    def _assemble(self, raws: list):
+        """One device-ready batch per dispatch: the single consumed batch
+        (chain == 1), or K consumed batches stacked on the chained layout
+        (``shard_chained_batch``'s contract: update axis replicated — the
+        scan consumes it sequentially — batch axis sharded on "data")."""
+        if self._chain_mesh is None:
+            return self._to_batch(raws[0])
+        from tpu_rl.parallel.dp import shard_chained_batch
+
+        return shard_chained_batch(
+            [self._to_batch(r) for r in raws], self._chain_mesh
+        )
+
     def _next_batch(self, store, rng) -> dict | None:
         if is_off_policy(self.cfg.algo):
             return store.sample(self.cfg.batch_size, rng)
