@@ -55,32 +55,35 @@ def measure(num_envs: int, seconds: float, base_port: int) -> dict:
     t.start()
 
     # Warmup gates on RECEIVED TRAFFIC, not wall-clock: wait for the first
-    # Rollout message (jit compile + ZMQ slow-join complete), then drain a
+    # rollout frame (jit compile + ZMQ slow-join complete), then drain a
     # short settle window. A fixed sleep understates throughput whenever
     # compile bleeds into the timed region on a slow/loaded host.
     warmup_deadline = time.time() + 120.0
     while time.time() < warmup_deadline:
         got = relay.recv(timeout_ms=100)
-        if got is not None and got[0] == Protocol.Rollout:
+        if got is not None and got[0] == Protocol.RolloutBatch:
             break
     else:
-        raise RuntimeError("worker produced no Rollout within 120 s warmup")
+        raise RuntimeError(
+            "worker produced no RolloutBatch frame within 120 s warmup"
+        )
     settle = time.time() + 1.0
     while time.time() < settle:
         relay.recv(timeout_ms=50)
-    n_msgs = 0
+    n_steps = 0
     t0 = time.time()
     deadline = t0 + seconds
     while time.time() < deadline:
         got = relay.recv(timeout_ms=100)
-        if got is not None and got[0] == Protocol.Rollout:
-            n_msgs += 1
+        if got is not None and got[0] == Protocol.RolloutBatch:
+            # one frame per tick = num_envs env-steps
+            n_steps += len(got[1]["id"])
     elapsed = time.time() - t0
     stop.set()
     t.join(timeout=30)
     relay.close()
     model_pub.close()
-    sps = n_msgs / elapsed
+    sps = n_steps / elapsed
     return dict(
         num_envs=num_envs,
         env_steps_per_s=round(sps, 1),

@@ -1,8 +1,9 @@
-"""Subprocess body for the real 2-process ``jax.distributed`` test.
+"""Subprocess body for the real N-process ``jax.distributed`` tests.
 
-Run as: ``python tests/multihost_child.py <process_id> <coordinator_port>``.
-Each process contributes 2 virtual CPU devices -> a 4-device global mesh.
-Validates, with ACTUAL cross-process collectives (gloo):
+Run as: ``python tests/multihost_child.py <process_id> <coordinator_port>
+[<num_processes>=2]``. Each process contributes 2 virtual CPU devices -> a
+``2N``-device global mesh. Validates, with ACTUAL cross-process collectives
+(gloo):
 
 1. ``tpu_rl.parallel.multihost.init_multihost`` brings up the runtime;
 2. the DP learner feed: ``host_local_batch_to_global`` under ``P("data")``
@@ -10,7 +11,11 @@ Validates, with ACTUAL cross-process collectives (gloo):
    global mesh == plain single-device jit on the same global batch;
 3. the sequence-parallel feed: ``P("data","seq")`` placement (non-batch
    index dims preserved — the round-2 fix) + ring attention whose K/V
-   rotation crosses the process boundary == single-device full attention.
+   rotation crosses the process boundary == single-device full attention;
+4. the PRODUCTION service feed: ``LearnerService._to_batch`` with the
+   multihost placement armed (``_setup_multihost_feed``) places this host's
+   raw shm-style rows as the correct slice of the global array — the same
+   train step through the service's own batching code == the oracle.
 
 Not collected by pytest (no ``test_`` prefix); driven by
 ``tests/test_multihost.py``.
@@ -25,6 +30,7 @@ import sys
 def main() -> None:
     pid = int(sys.argv[1])
     port = sys.argv[2]
+    nprocs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
     os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -38,10 +44,10 @@ def main() -> None:
     from tpu_rl.parallel.multihost import init_multihost, is_multihost
 
     init_multihost(
-        coordinator=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+        coordinator=f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid
     )
-    assert is_multihost(), "process_count must be 2 after init_multihost"
-    assert len(jax.devices()) == 4, jax.devices()
+    assert is_multihost(), "process_count must be > 1 after init_multihost"
+    assert len(jax.devices()) == 2 * nprocs, jax.devices()
     assert len(jax.local_devices()) == 2
 
     import jax.numpy as jnp
@@ -58,7 +64,7 @@ def main() -> None:
     from tpu_rl.parallel.multihost import host_local_batch_to_global
     from tpu_rl.types import BATCH_FIELDS, Batch
 
-    # ---------------- 2. DP path: global batch 8 rows, 4 per host ----------
+    # ------------- 2. DP path: global batch 8 rows, 8/nprocs per host ------
     cfg = Config.from_dict(
         dict(
             algo="IMPALA", hidden_size=16, seq_len=5, batch_size=8,
@@ -86,11 +92,12 @@ def main() -> None:
     s_ref, m_ref = jax.jit(train_step)(state, global_batch, key)
     loss_ref = float(np.asarray(m_ref["loss"]))
 
-    # DP over the 4-device global mesh, each host feeding its own 4 rows.
-    mesh = make_mesh(4)
+    # DP over the 2N-device global mesh, each host feeding its own rows.
+    mesh = make_mesh(2 * nprocs)
     pstep = make_parallel_train_step(train_step, mesh, cfg)
+    rows = cfg.batch_size // nprocs
     local_rows = {
-        f: np.asarray(getattr(global_batch, f))[pid * 4:(pid + 1) * 4]
+        f: np.asarray(getattr(global_batch, f))[pid * rows:(pid + 1) * rows]
         for f in BATCH_FIELDS
     }
     fed = Batch(**host_local_batch_to_global(local_rows, batch_sharding(mesh)))
@@ -103,17 +110,19 @@ def main() -> None:
         loss_dp, loss_ref,
     )
 
-    # ------------- 3. Seq-sharded path: (data=2, seq=2) mesh, ring ---------
+    # ---------- 3. Seq-sharded path: (data=nprocs, seq=2) mesh, ring -------
     from tpu_rl.parallel import make_sp_mesh
 
+    n_data, n_seq = nprocs, 2  # uses every device: n_data * n_seq == 2N
     cfg_sp = Config.from_dict(
         dict(
             algo="PPO", model="transformer", attention_impl="ring",
-            hidden_size=16, n_heads=2, n_layers=1, seq_len=8, batch_size=4,
-            obs_shape=(4,), action_space=2, mesh_data=2, mesh_seq=2,
+            hidden_size=16, n_heads=2, n_layers=1, seq_len=8,
+            batch_size=max(4, n_data),
+            obs_shape=(4,), action_space=2, mesh_data=n_data, mesh_seq=n_seq,
         )
     )
-    sp_mesh = make_sp_mesh(2, 2)
+    sp_mesh = make_sp_mesh(n_data, n_seq)
     fam_sp, state_sp, step_sp = get_algo("PPO").build(
         cfg_sp, jax.random.key(1), mesh=sp_mesh
     )
@@ -147,10 +156,13 @@ def main() -> None:
     from tpu_rl.parallel.sequence import DATA_AXIS, SEQ_AXIS
 
     sp_sharding = NamedSharding(sp_mesh, P(DATA_AXIS, SEQ_AXIS))
-    # Host rows of the (data, seq)-sharded batch: data axis 2 -> 2 rows/host;
-    # trailing (seq) dim stays global-sized locally and is sliced per device
-    # by host_local_batch_to_global (the round-2 fix under test).
-    local_sp = {f: v[pid * 2:(pid + 1) * 2] for f, v in gb.items()}
+    # Host rows of the (data, seq)-sharded batch; the trailing (seq) dim
+    # stays global-sized locally and is sliced per device by
+    # host_local_batch_to_global (the round-2 fix under test).
+    sp_rows = cfg_sp.batch_size // nprocs
+    local_sp = {
+        f: v[pid * sp_rows:(pid + 1) * sp_rows] for f, v in gb.items()
+    }
     fed_sp = Batch(**host_local_batch_to_global(local_sp, sp_sharding))
     pstep_sp = make_sp_train_step(step_sp, sp_mesh, cfg_sp)
     state_sp = replicate(state_sp, sp_mesh)
@@ -160,9 +172,29 @@ def main() -> None:
         loss_sp, loss_full,
     )
 
+    # ------- 4. Production service feed: LearnerService._to_batch ---------
+    # The service arms multihost placement in run() via _setup_multihost_feed
+    # (jax.process_count() > 1); drive the same code path directly: raw
+    # host-local rows (what its shm store consume() yields on this host) must
+    # place as THIS host's slice of the global batch, and the DP step through
+    # the service's own batching must match the single-device oracle.
+    from tpu_rl.runtime.learner_service import LearnerService
+
+    svc = LearnerService(cfg, handles=None, model_port=0)
+    svc._place_global = None
+    svc._setup_multihost_feed(batch_sharding(mesh))
+    assert svc._place_global is not None, "service must arm multihost feed"
+    fed_svc = svc._to_batch(local_rows)
+    _f3, state3, _t3 = get_algo(cfg.algo).build(cfg, jax.random.key(0))
+    s_svc, m_svc = pstep(replicate(state3, mesh), fed_svc, replicate(key, mesh))
+    loss_svc = float(np.asarray(m_svc["loss"]))
+    assert abs(loss_svc - loss_ref) < 1e-4 * max(1.0, abs(loss_ref)), (
+        loss_svc, loss_ref,
+    )
+
     print(
-        f"MULTIHOST_CHILD_OK pid={pid} loss_dp={loss_dp:.6f} "
-        f"loss_sp={loss_sp:.6f}",
+        f"MULTIHOST_CHILD_OK pid={pid} nprocs={nprocs} loss_dp={loss_dp:.6f} "
+        f"loss_sp={loss_sp:.6f} loss_svc={loss_svc:.6f}",
         flush=True,
     )
 

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 
 from tests.conftest import small_config
-from tpu_rl.data.assembler import RolloutAssembler
+from tpu_rl.data.assembler import RolloutAssembler, split_rollout_batch
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import OnPolicyStore, ReplayStore, alloc_handles, make_store
 from tpu_rl.types import BATCH_FIELDS
@@ -52,6 +52,44 @@ class TestAssembler:
             assert win[f].shape == (layout.seq_len, layout.width(f))
         # steps in push order
         assert list(win["rew"][:, 0]) == list(range(layout.seq_len))
+
+    def test_split_rollout_batch_roundtrips_through_assembler(self, layout):
+        """A stacked worker tick (Protocol.RolloutBatch) split into steps
+        must assemble identically to the same steps pushed individually."""
+        n_envs = 3
+        rng = np.random.default_rng(3)
+        ticks = []
+        for t in range(layout.seq_len):
+            ticks.append({
+                **{
+                    f: rng.standard_normal(
+                        (n_envs, layout.width(f))
+                    ).astype(np.float32)
+                    for f in BATCH_FIELDS
+                },
+                "id": [f"e{i}" for i in range(n_envs)],
+                "done": np.zeros(n_envs, np.uint8),
+            })
+        asm_b = RolloutAssembler(layout, clock=FakeClock())
+        for tick in ticks:
+            steps = split_rollout_batch(tick)
+            assert len(steps) == n_envs
+            for s in steps:
+                asm_b.push(s)
+        asm_s = RolloutAssembler(layout, clock=FakeClock())
+        for tick in ticks:
+            for i in range(n_envs):
+                asm_s.push({
+                    **{f: tick[f][i] for f in BATCH_FIELDS},
+                    "id": tick["id"][i],
+                    "done": False,
+                })
+        for _ in range(n_envs):
+            wb, ws = asm_b.pop(), asm_s.pop()
+            assert wb is not None and ws is not None
+            for f in BATCH_FIELDS:
+                np.testing.assert_array_equal(wb[f], ws[f])
+        assert asm_b.pop() is None and asm_s.pop() is None
 
     def test_interleaved_episodes_keyed_by_id(self, layout):
         asm = RolloutAssembler(layout, clock=FakeClock())

@@ -1,10 +1,12 @@
-"""Real 2-process ``jax.distributed`` test (SURVEY.md §2.4 scaled-backend
-capability): two subprocess "hosts" with 2 virtual CPU devices each bring up
+"""Real N-process ``jax.distributed`` tests (SURVEY.md §2.4 scaled-backend
+capability): subprocess "hosts" with 2 virtual CPU devices each bring up
 the distributed runtime via ``tpu_rl.parallel.multihost.init_multihost`` and
-run REAL cross-process collectives — the DP gradient all-reduce and the ring
-attention K/V rotation — validating ``host_local_batch_to_global``'s
-contiguous-rows assumption and the learner's multihost feed against
-single-device oracles. Body: ``tests/multihost_child.py``."""
+run REAL cross-process collectives — the DP gradient all-reduce, the ring
+attention K/V rotation, and the production ``LearnerService._to_batch``
+multihost feed — validating ``host_local_batch_to_global``'s
+contiguous-rows assumption against single-device oracles, at 2 AND 4
+processes (4 = collectives spanning more than one peer hop).
+Body: ``tests/multihost_child.py``."""
 
 import os
 import subprocess
@@ -16,23 +18,21 @@ import pytest
 CHILD = os.path.join(os.path.dirname(__file__), "multihost_child.py")
 
 
-@pytest.mark.timeout(420)
-def test_two_process_distributed_runtime():
-    port = 29950
+def _run_children(nprocs: int, port: int) -> None:
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(CHILD))
     procs = [
         subprocess.Popen(
-            [sys.executable, CHILD, str(pid), str(port)],
+            [sys.executable, CHILD, str(pid), str(port), str(nprocs)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
         )
-        for pid in (0, 1)
+        for pid in range(nprocs)
     ]
     deadline = time.time() + 360
-    outs = [None, None]
+    outs: list = [None] * nprocs
     try:
         for i, p in enumerate(procs):
             remaining = max(5.0, deadline - time.time())
@@ -47,11 +47,23 @@ def test_two_process_distributed_runtime():
                 except Exception:
                     outs[i] = "<no output>"
         pytest.fail(
-            "2-process distributed run timed out\n"
-            f"--- pid 0 ---\n{outs[0][-3000:]}\n--- pid 1 ---\n{outs[1][-3000:]}"
+            f"{nprocs}-process distributed run timed out\n" + "\n".join(
+                f"--- pid {i} ---\n{(outs[i] or '')[-3000:]}"
+                for i in range(nprocs)
+            )
         )
     for i, p in enumerate(procs):
         assert p.returncode == 0, (
             f"child {i} rc={p.returncode}\n{outs[i][-3000:]}"
         )
         assert "MULTIHOST_CHILD_OK" in outs[i], outs[i][-3000:]
+
+
+@pytest.mark.timeout(420)
+def test_two_process_distributed_runtime():
+    _run_children(2, 29950)
+
+
+@pytest.mark.timeout(420)
+def test_four_process_distributed_runtime():
+    _run_children(4, 29954)

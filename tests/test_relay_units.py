@@ -32,10 +32,16 @@ class TestManager:
         m = _manager()
         pub = FakePub()
         for i in range(RELAY_QUEUE_MAX + 10):
-            m._ingest(Protocol.Rollout, {"i": i}, pub)
+            proto = (
+                Protocol.RolloutBatch if i % 2 else Protocol.Rollout
+            )  # both frame kinds share the relay queue
+            m._ingest(proto, {"i": i}, pub)
         assert len(m.queue) == RELAY_QUEUE_MAX
         # the 10 oldest were shed (stale rollouts are least on-policy)
-        assert m.queue[0]["i"] == 10
+        proto0, payload0 = m.queue[0]
+        assert payload0["i"] == 10 and proto0 == Protocol.Rollout
+        # frames relay with their ORIGINAL protocol byte (never re-encoded)
+        assert m.queue[1][0] == Protocol.RolloutBatch
 
     def test_stat_window_publishes_mean_every_50(self):
         m = _manager()

@@ -200,12 +200,14 @@ def test_worker_warm_start_from_checkpoint(tmp_path):
     sup = Supervisor()
     worker_role(cfg, machines, supervisor=sup)
     try:
+        from tpu_rl.data.assembler import split_rollout_batch
+
         msg = None
         deadline = time.time() + 120
         while time.time() < deadline and msg is None:
             got = sub.recv(timeout_ms=1000)
-            if got is not None and got[0] == Protocol.Rollout:
-                msg = got[1]
+            if got is not None and got[0] == Protocol.RolloutBatch:
+                msg = split_rollout_batch(got[1])[0]
         assert msg is not None, "no rollout received from warm-started worker"
         expected = family.act(
             {"actor": state.params["actor"]},
@@ -405,12 +407,14 @@ def _crash_main(stop_event, heartbeat):
 @pytest.mark.timeout(300)
 def test_vectorized_worker_rollout():
     """worker_num_envs=4: one worker process drives 4 envs with a single
-    batched act per tick. The manager-side SUB must see per-step messages
-    from 4 concurrently-open episodes, each starting with an is_fir=1 seam,
-    with per-env carries (a reset zeroes only that env's rows — observable
-    as a fresh episode id whose first message carries is_fir=1)."""
+    batched act per tick and ONE framed RolloutBatch per tick (4 stacked
+    transitions). Split back into steps, the stream must show 4
+    concurrently-open episodes, each starting with an is_fir=1 seam, with
+    per-env carries (a reset zeroes only that env's rows — observable as a
+    fresh episode id whose first message carries is_fir=1)."""
     import threading
 
+    from tpu_rl.data.assembler import split_rollout_batch
     from tpu_rl.runtime.protocol import Protocol
     from tpu_rl.runtime.transport import Pub, Sub
     from tpu_rl.runtime.worker import Worker
@@ -436,7 +440,12 @@ def test_vectorized_worker_rollout():
             if got is None:
                 continue
             proto, payload = got
-            (msgs if proto == Protocol.Rollout else stats).append(payload)
+            if proto == Protocol.RolloutBatch:
+                steps = split_rollout_batch(payload)
+                assert len(steps) == 4  # one frame = one 4-env tick
+                msgs.extend(steps)
+            else:
+                stats.append(payload)
     finally:
         stop.set()
         t.join(timeout=30)

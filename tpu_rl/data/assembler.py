@@ -65,6 +65,26 @@ def stack_window(steps: list[dict]) -> dict[str, np.ndarray]:
     }
 
 
+def split_rollout_batch(payload: dict) -> list[dict]:
+    """One worker tick's stacked transitions -> per-step dicts for
+    :meth:`RolloutAssembler.push`.
+
+    Inverse of the worker's per-tick stacking (``runtime/worker.py``,
+    ``Protocol.RolloutBatch``): every batch field is an ``(n_envs, width)``
+    array, ``id`` is a list of per-env episode ids, ``done`` an ``(n_envs,)``
+    array. Row views (no copies) — ``stack_window`` copies when it stacks."""
+    ids = payload["id"]
+    done = np.asarray(payload["done"])
+    return [
+        {
+            **{f: payload[f][i] for f in BATCH_FIELDS},
+            "id": ids[i],
+            "done": bool(done[i]),
+        }
+        for i in range(len(ids))
+    ]
+
+
 class RolloutAssembler:
     def __init__(
         self,

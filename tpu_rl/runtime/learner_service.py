@@ -175,8 +175,11 @@ class LearnerService:
         writer = make_writer(cfg.result_dir)
         logger = LearnerLogger(writer, cfg.algo)
         # One timed window per DISPATCH; a chained dispatch carries
-        # chain x (seq x batch) transitions.
-        timer = ExecutionTimer(
+        # chain x (seq x batch) transitions. Kept on self so harnesses
+        # (examples/run_tpu_e2e_learner.py) can read the steady-state
+        # windowed rates after run() — the window excludes idle polls and
+        # dilutes the first dispatch's compile across the deque.
+        timer = self.timer = ExecutionTimer(
             num_transition=cfg.seq_len * cfg.batch_size * chain
         )
         key = jax.random.key(self.seed + 1)

@@ -71,14 +71,20 @@ class Manager:
             self._ingest(proto, payload, pub)
             moved += 1
         while self.queue:
-            pub.send(Protocol.Rollout, self.queue.popleft())
+            pub.send(*self.queue.popleft())
             self.n_forwarded += 1
             moved += 1
         return moved
 
     def _ingest(self, proto: Protocol, payload, pub: Pub) -> None:
-        if proto == Protocol.Rollout:
-            self.queue.append(payload)  # drop-oldest at maxlen
+        if proto in (Protocol.Rollout, Protocol.RolloutBatch):
+            # Relay a RolloutBatch as one frame — never unpacked into
+            # per-step messages (the SUB/PUB hop still decodes+re-encodes
+            # once per frame, so batching also cuts this hop's codec calls
+            # N-fold). Drop-oldest granularity is therefore one frame: a
+            # whole tick for batched workers, exactly the steps that are
+            # most stale together.
+            self.queue.append((proto, payload))  # drop-oldest at maxlen
         elif proto == Protocol.Stat:
             self.stat_q.append(float(payload))
             self.n_stats += 1

@@ -252,6 +252,14 @@ class Protocol(enum.IntEnum):
     Model = 0  # learner -> workers: parameter broadcast
     Rollout = 1  # worker -> manager -> storage: one env step
     Stat = 2  # worker -> manager -> storage: episode reward
+    # One worker TICK: all worker_num_envs transitions stacked on a leading
+    # env axis, one frame. The reference publishes one dict per env step
+    # (``agents/worker.py:110-125``); at 32 envs that is 32 encode+send
+    # calls per tick, and framing overhead was measured to cap the wire at
+    # ~3.2k env-steps/s — batched, one encode covers the whole tick (and
+    # the stacked arrays compress far better). Split back into per-step
+    # dicts by ``tpu_rl.data.assembler.split_rollout_batch``.
+    RolloutBatch = 3
 
 
 class Codec(enum.IntEnum):

@@ -14,7 +14,7 @@ from __future__ import annotations
 import time
 
 from tpu_rl.config import Config
-from tpu_rl.data.assembler import RolloutAssembler
+from tpu_rl.data.assembler import RolloutAssembler, split_rollout_batch
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import ShmHandles, make_store
 from tpu_rl.runtime.protocol import Protocol
@@ -65,6 +65,12 @@ class LearnerStorage:
     def _ingest(self, proto: Protocol, payload, assembler) -> None:
         if proto == Protocol.Rollout:
             assembler.push(payload)
+        elif proto == Protocol.RolloutBatch:
+            # One worker tick, all envs stacked: unpack at the storage edge
+            # (the only hop that needs per-step granularity — the assembler
+            # keys on episode id).
+            for step in split_rollout_batch(payload):
+                assembler.push(step)
         elif proto == Protocol.Stat:
             self._relay_stat(payload)
 
