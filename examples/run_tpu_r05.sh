@@ -1,0 +1,87 @@
+#!/bin/sh
+# Round-5 on-chip drain — run the MOMENT the tunnel probe succeeds.
+# Priority-ordered for short windows (rounds 3-4 saw 11-25 min windows
+# between multi-hour outages); every step is timeout-bounded so a dying
+# tunnel kills the step, not the chain. Probe first:
+#
+#   timeout 90 python -c "import jax; print(jax.devices())"
+#
+# Never clobber PYTHONPATH without /root/.axon_site (the TPU plugin
+# registers there); bench.py routes CPU fallbacks away from the committed
+# on-chip artifacts by itself.
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. FULL matrix with the round-5 code. One run covers three debts at once:
+#    the V-MPO@ref row after the mask rewrite (was 1.198 ms/update, 10x its
+#    siblings, from the topk+gather lowering), the longctx-flash row with
+#    the tuned gcd(512,T) tiles now in the dispatch (committed matrix still
+#    shows the library-default 190.7 ms), and a fresh bench_results.json
+#    (with recorded_at) for the outage-proof headline to embed.
+timeout 1800 env PYTHONPATH=/root/repo:/root/.axon_site python bench.py
+
+# 2. End-to-end learner FPS through the real shm feed with the production
+#    chained dispatch (VERDICT r4 weak #6 — every prior on-chip number is a
+#    synthetic-batch row).
+timeout 600 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python examples/run_tpu_e2e_learner.py \
+    --updates 2048 --chain 16 --out bench_e2e_learner.json
+
+# 3. Wide-LSTM MFU attribution (22% ceiling, bf16 buying nothing): profiled
+#    f32 + bf16 rows, then the trace top-op summaries that name the
+#    bottleneck (recurrent matmul vs gate VPU vs HBM).
+timeout 900 env PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import json
+import bench
+for dtype in ("float32", "bfloat16"):
+    row = bench.bench_one(
+        f"IMPALA@wide-lstm-{dtype}-profiled",
+        dict(algo="IMPALA", batch_size=1024, seq_len=16, hidden_size=1024,
+             obs_shape=(64,), action_space=8, compute_dtype=dtype,
+             profile_dir=f"/tmp/tpu_rl_widelstm_{dtype}_trace"),
+        5, 15,
+    )
+    print(json.dumps(row))
+EOF
+timeout 300 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python examples/trace_top_ops.py /tmp/tpu_rl_widelstm_float32_trace || true
+timeout 300 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python examples/trace_top_ops.py /tmp/tpu_rl_widelstm_bfloat16_trace || true
+
+# 4. Flash-attention op-level sweep re-record (round-4 item 2: the
+#    committed sweep's "full" fwd row is warmup-contaminated).
+timeout 900 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python examples/bench_flash_attention.py
+
+# 5. Long-context train-step trace (round-4 item 3) — only reached in a
+#    long window; attributes the remaining flash-row gap.
+timeout 600 env PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import json
+import bench
+row = bench.bench_one(
+    "PPO-transformer@longctx-flash-profiled",
+    dict(algo="PPO", model="transformer", compute_dtype="bfloat16",
+         attention_impl="flash", batch_size=16, seq_len=2048,
+         hidden_size=512, n_heads=8, n_layers=4, obs_shape=(64,),
+         action_space=8, profile_dir="/tmp/tpu_rl_longctx_trace"),
+    3, 10,
+)
+print(json.dumps(row))
+EOF
+timeout 300 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python examples/trace_top_ops.py /tmp/tpu_rl_longctx_trace || true
+
+# 6. V-MPO step trace — only if step 1 shows the row still anomalous.
+timeout 600 env PYTHONPATH=/root/repo:/root/.axon_site python - <<'EOF'
+import json
+import bench
+row = bench.bench_one(
+    "V-MPO@ref-profiled",
+    dict(algo="V-MPO", obs_shape=(4,), action_space=2, batch_size=128,
+         seq_len=5, hidden_size=64, profile_dir="/tmp/tpu_rl_vmpo_trace"),
+    5, 20, 16,
+)
+print(json.dumps(row))
+EOF
+timeout 300 env PYTHONPATH=/root/repo:/root/.axon_site \
+    python examples/trace_top_ops.py /tmp/tpu_rl_vmpo_trace || true

@@ -190,3 +190,53 @@ def test_bf16_lstm_mixed_precision():
     state, metrics = jax.jit(train_step)(state, batch, jax.random.PRNGKey(3))
     for k, v in metrics.items():
         assert np.isfinite(np.asarray(v)).all(), (k, v)
+
+
+def test_mixed_dot_bf16_both_passes():
+    """``mixed_dot`` (the bf16 recurrent matmul) must (a) match the plain
+    f32 dot's value and gradients within bf16 rounding, and (b) emit dots
+    whose operands are BOTH reduced-precision in the backward too — a plain
+    ``dot(a.bf16, b.bf16)`` gets an f32 cotangent and its backward dots
+    run mixed f32 x bf16 at f32 rate, which is exactly the measured-zero
+    bf16 speedup this op exists to fix (round-4 wide-LSTM row)."""
+    from tpu_rl.ops.pallas_lstm import mixed_dot
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((16, 32)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((32, 64)).astype(np.float32))
+    w = jnp.asarray(rng.standard_normal((16, 64)).astype(np.float32))
+
+    v16, g16 = jax.value_and_grad(
+        lambda a, b: (mixed_dot(a, b) * w).sum(), argnums=(0, 1)
+    )(a, b)
+    v32, g32 = jax.value_and_grad(
+        lambda a, b: ((a @ b) * w).sum(), argnums=(0, 1)
+    )(a, b)
+    np.testing.assert_allclose(float(v16), float(v32), rtol=2e-2)
+    for x16, x32 in zip(g16, g32):
+        np.testing.assert_allclose(
+            np.asarray(x16), np.asarray(x32), rtol=5e-2, atol=0.2
+        )
+        assert x16.dtype == jnp.float32  # f32 accumulation/results
+
+    # structural check: every backward dot_general consumes two bf16
+    # operands (no f32 x bf16 mixed dots that defeat the MXU fast path)
+    jaxpr = jax.make_jaxpr(
+        jax.grad(lambda a, b: (mixed_dot(a, b) * w).sum(), argnums=(0, 1))
+    )(a, b)
+    import re
+
+    txt = str(jaxpr)
+    # collect "x:dtype[shape] = dot_general[...] y z" operand dtypes by
+    # tracing variable declarations
+    decl = dict(re.findall(r"(\w+):(\w+)\[", txt))
+    # every dot here carries preferred_element_type=float32 as its last
+    # bracket line; operands follow the closing bracket
+    dots = re.findall(
+        r"preferred_element_type=float32\s*\]\s*(\w+)\s+(\w+)", txt
+    )
+    assert len(dots) >= 3, f"expected fwd+2 bwd dots, found {dots}"
+    for op1, op2 in dots:
+        assert decl.get(op1) == "bf16" and decl.get(op2) == "bf16", (
+            op1, op2, decl.get(op1), decl.get(op2),
+        )

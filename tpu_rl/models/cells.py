@@ -104,10 +104,15 @@ class LSTMCell(nn.Module):
     hidden: int
     # Matmul compute dtype (params stay float32): jnp.bfloat16 runs the
     # input projection and the recurrent matmul at MXU bf16 rate with f32
-    # accumulation; gates, carry, and outputs stay float32. None = float32.
-    # The fused Pallas kernel is f32-only — bf16 compute always takes the
-    # scan path (the MXU-loading wide shapes are multi-tile, where the scan
-    # is the measured winner anyway; see _use_pallas).
+    # accumulation — in BOTH passes (the recurrent matmul goes through
+    # pallas_lstm.mixed_dot, whose custom VJP casts the cotangent too; a
+    # plain bf16 dot's backward receives an f32 cotangent and runs mixed
+    # f32 x bf16 at f32 rate, which measured as zero bf16 speedup on the
+    # round-4 wide-LSTM row). Gates, carry, and outputs stay float32.
+    # None = float32. The fused Pallas kernel is f32-only — bf16 compute
+    # always takes the scan path (the MXU-loading wide shapes are
+    # multi-tile, where the scan is the measured winner anyway; see
+    # _use_pallas).
     dtype: jnp.dtype | None = None
 
     def setup(self):
@@ -121,11 +126,9 @@ class LSTMCell(nn.Module):
     def _rec_matmul(self, h: jax.Array) -> jax.Array:
         if self.dtype is None:
             return h @ self.recurrent_kernel
-        return jnp.dot(
-            h.astype(self.dtype),
-            self.recurrent_kernel.astype(self.dtype),
-            preferred_element_type=jnp.float32,
-        )
+        from tpu_rl.ops.pallas_lstm import mixed_dot
+
+        return mixed_dot(h, self.recurrent_kernel, self.dtype)
 
     def _gates(self, z: jax.Array, c: jax.Array) -> tuple[jax.Array, jax.Array]:
         H = self.hidden

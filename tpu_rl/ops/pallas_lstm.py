@@ -190,6 +190,41 @@ def bwd_batch_tile(batch: int, seq: int, hidden: int) -> int | None:
     return _best_tile(batch, fits)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def mixed_dot(a, b, dtype=jnp.bfloat16):
+    """``a @ b`` with BOTH passes at reduced-precision MXU rate, f32 out.
+
+    A plain ``dot(a.astype(bf16), b.astype(bf16), preferred f32)`` only
+    accelerates the FORWARD: its AD transpose receives an f32 cotangent, so
+    both backward matmuls are mixed f32 x bf16 dots that XLA runs at f32
+    rate — measured as the round-4 "bf16 gave nothing" wide-LSTM row
+    (10.25 ms bf16 vs 10.16 f32; the backward holds ~2/3 of the matmul
+    FLOPs). This VJP casts the cotangent to ``dtype`` too — standard
+    mixed-precision practice; gradients pick up one bf16 rounding, while
+    accumulation (``preferred_element_type``) and all results stay f32."""
+    return jnp.dot(
+        a.astype(dtype), b.astype(dtype), preferred_element_type=jnp.float32
+    )
+
+
+def _mixed_dot_fwd(a, b, dtype):
+    # Residuals saved PRE-cast to ``dtype``: identical backward numerics
+    # (the cast is idempotent), half the stacked-residual bytes under a
+    # scan, and no per-step re-cast of the loop-invariant weights.
+    return mixed_dot(a, b, dtype), (a.astype(dtype), b.astype(dtype))
+
+
+def _mixed_dot_bwd(dtype, res, g):
+    ad, bd = res
+    gd = g.astype(dtype)
+    da = jnp.dot(gd, bd.T, preferred_element_type=jnp.float32)
+    db = jnp.dot(ad.T, gd, preferred_element_type=jnp.float32)
+    return da, db
+
+
+mixed_dot.defvjp(_mixed_dot_fwd, _mixed_dot_bwd)
+
+
 def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None, want_cs=False):
     """Plain ``lax.scan`` forward over the precomputed input projection —
     the measured winner for UNdifferentiated unrolls (the fused kernel is
@@ -197,10 +232,10 @@ def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None, want_cs=False):
     bench_lstm_kernel.json; it wins only when the fused backward is in
     play).
 
-    ``matmul_dtype`` (e.g. ``jnp.bfloat16``) casts ONLY the recurrent
-    matmul operands — MXU-rate compute with f32 accumulation
-    (``preferred_element_type``); the carry, gate math, and outputs stay
-    float32. None = pure float32 (bit-identical to the fused kernel).
+    ``matmul_dtype`` (e.g. ``jnp.bfloat16``) runs the recurrent matmul
+    through :func:`mixed_dot` — MXU-rate compute in BOTH passes with f32
+    accumulation; the carry, gate math, and outputs stay float32.
+    None = pure float32 (bit-identical to the fused kernel).
 
     Returns ``(hs, (h_last, c_last))`` by default; ``want_cs=True`` stacks
     the full per-step cell state and returns ``(hs, cs)`` instead — only
@@ -208,18 +243,18 @@ def _scan_forward(xp, wh, h0, c0, keep, matmul_dtype=None, want_cs=False):
     is (B,S,H) pairs); every other caller consumes just the final carry,
     and stacking cs for them would write an extra (B,S,H) buffer per
     forward (~64 MB at the wide bench shape)."""
-    wh_m = wh if matmul_dtype is None else wh.astype(matmul_dtype)
-
     def step(carry, xs):
         h, c = carry
         xp_t, keep_t = xs
         kp = keep_t[:, None]
         h = h * kp
         c = c * kp
-        hm = h if matmul_dtype is None else h.astype(matmul_dtype)
-        z = xp_t.astype(jnp.float32) + jnp.dot(
-            hm, wh_m, preferred_element_type=jnp.float32
+        rec = (
+            jnp.dot(h, wh, preferred_element_type=jnp.float32)
+            if matmul_dtype is None
+            else mixed_dot(h, wh, matmul_dtype)
         )
+        z = xp_t.astype(jnp.float32) + rec
         H = wh.shape[0]
         i = jax.nn.sigmoid(z[:, :H])
         f = jax.nn.sigmoid(z[:, H : 2 * H])
