@@ -71,6 +71,37 @@ def rng():
     return np.random.default_rng(0)
 
 
+def dot_operand_dtypes(closed_jaxpr) -> list[tuple[str, str]]:
+    """Every ``dot_general``'s (lhs, rhs) operand dtypes across the WHOLE
+    jaxpr tree, by structural traversal into sub-jaxprs (scan bodies,
+    custom-VJP calls, cond branches). Used by the mixed-precision structure
+    tests: text/regex parsing of ``str(jaxpr)`` is unsound — sub-jaxprs
+    restart variable naming at ``a, b, c...``, so a flat name->dtype lookup
+    is last-wins, and dots without a ``preferred_element_type`` marker are
+    easy to miss."""
+    out: list[tuple[str, str]] = []
+
+    def walk_param(v):
+        if hasattr(v, "jaxpr"):  # ClosedJaxpr
+            walk(v.jaxpr)
+        elif hasattr(v, "eqns"):  # Jaxpr
+            walk(v)
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                walk_param(item)
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dot_general":
+                a, b = eqn.invars[0].aval.dtype, eqn.invars[1].aval.dtype
+                out.append((str(a), str(b)))
+            for v in eqn.params.values():
+                walk_param(v)
+
+    walk(closed_jaxpr.jaxpr)
+    return out
+
+
 def small_config(**kw) -> Config:
     base = dict(
         hidden_size=16,

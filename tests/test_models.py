@@ -219,24 +219,15 @@ def test_mixed_dot_bf16_both_passes():
         )
         assert x16.dtype == jnp.float32  # f32 accumulation/results
 
-    # structural check: every backward dot_general consumes two bf16
-    # operands (no f32 x bf16 mixed dots that defeat the MXU fast path)
+    # structural check: every dot_general in fwd+bwd consumes two bf16
+    # operands (no f32 x bf16 mixed dots that defeat the MXU fast path);
+    # structural jaxpr traversal, not text parsing (see conftest)
+    from tests.conftest import dot_operand_dtypes
+
     jaxpr = jax.make_jaxpr(
         jax.grad(lambda a, b: (mixed_dot(a, b) * w).sum(), argnums=(0, 1))
     )(a, b)
-    import re
-
-    txt = str(jaxpr)
-    # collect "x:dtype[shape] = dot_general[...] y z" operand dtypes by
-    # tracing variable declarations
-    decl = dict(re.findall(r"(\w+):(\w+)\[", txt))
-    # every dot here carries preferred_element_type=float32 as its last
-    # bracket line; operands follow the closing bracket
-    dots = re.findall(
-        r"preferred_element_type=float32\s*\]\s*(\w+)\s+(\w+)", txt
-    )
+    dots = dot_operand_dtypes(jaxpr)
     assert len(dots) >= 3, f"expected fwd+2 bwd dots, found {dots}"
-    for op1, op2 in dots:
-        assert decl.get(op1) == "bf16" and decl.get(op2) == "bf16", (
-            op1, op2, decl.get(op1), decl.get(op2),
-        )
+    for d1, d2 in dots:
+        assert d1 == "bfloat16" and d2 == "bfloat16", (d1, d2, dots)

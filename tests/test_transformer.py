@@ -170,6 +170,36 @@ class TestSequenceParallelTrainStep:
             make_sp_train_step(step, mesh, cfg)
 
 
+class TestMixedPrecisionStructure:
+    @pytest.mark.parametrize("impl", ["full", "blockwise"])
+    def test_bf16_train_step_has_no_mixed_dtype_dots(self, impl):
+        """Every dot_general in a bfloat16 transformer train step must take
+        SAME-dtype operands: a mixed f32 x bf16 dot runs at f32 rate on the
+        MXU, silently forfeiting the speedup bf16 mode exists for. (The
+        measured instances: f32 softmax probabilities contracting against
+        bf16 values, and f32 cotangents leaving the attention VJP into the
+        bf16 projection backward — round-5 fixes in parallel/sequence.py
+        ``_contract_dtype`` / ``_make_mp_einsum``; the LSTM analogue was
+        pallas_lstm.mixed_dot.) f32 x f32 dots are fine (losses, heads);
+        mixed pairs are the regression this pins, across EVERY dot in the
+        jaxpr tree (structural traversal — see conftest)."""
+        from tests.conftest import dot_operand_dtypes
+
+        cfg = _tf_config(
+            algo="PPO", attention_impl=impl, compute_dtype="bfloat16",
+            batch_size=4,
+        )
+        from tests.test_algos import make_batch
+
+        fam, state, step = get_algo("PPO").build(cfg, jax.random.key(0))
+        batch = make_batch(cfg, fam)
+        jaxpr = jax.make_jaxpr(step)(state, batch, jax.random.key(1))
+        dots = dot_operand_dtypes(jaxpr)
+        assert dots, "no dots found — jaxpr traversal broken?"
+        mixed = [(a, b) for a, b in dots if a != b]
+        assert not mixed, f"mixed-dtype dots: {mixed}"
+
+
 class TestTransformerActing:
     def test_act_carry_protocol(self, rng):
         cfg = _tf_config(act_ctx=8)

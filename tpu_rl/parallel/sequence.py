@@ -91,13 +91,79 @@ def _masked_block_scores(q, k, q_pos, k_pos, q_seg, k_seg, scale, causal):
     """(B, H, Tq, Tk) masked logits for one Q-block/K-block pair. Always
     float32: bf16 inputs hit the MXU, accumulation stays full-precision
     (the canonical TPU mixed-precision pattern)."""
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * jnp.float32(scale)
+    scores = _qk_scores_dot(q, k, _contract_dtype(q)) * jnp.float32(scale)
     mask = q_seg[:, None, :, None] == k_seg[:, None, None, :]
     if causal:
         mask &= q_pos[:, None, :, None] >= k_pos[:, None, None, :]
     return jnp.where(mask, scores, _NEG_INF)
+
+
+def _contract_dtype(x: jax.Array) -> jnp.dtype:
+    """Dtype for attention CONTRACTION operands: the input's own dtype for
+    low-precision inputs (bf16 x bf16 hits the MXU fast path; a mixed
+    f32 x bf16 dot runs at f32 rate — the softmax probabilities are f32, so
+    without the cast every probs-against-values contraction pays full f32),
+    f32 otherwise. Accumulation is always f32 (``preferred_element_type``);
+    softmax statistics and elementwise math stay f32 regardless.
+    Returns the scalar type CLASS (``jnp.bfloat16``), not a dtype instance
+    — custom_vjp static args must be plain hashable Python values."""
+    return jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+
+
+def _make_mp_einsum(spec, da_spec, db_spec, db_primal_first):
+    """Bilinear einsum as a custom-VJP op whose BACKWARD also contracts in
+    ``dtype``: the autodiff transpose of a plain einsum receives an f32
+    cotangent, so for bf16 inputs every backward dot would be a mixed
+    f32 x bf16 dot at f32 MXU rate (the same failure mode
+    ``ops.pallas_lstm.mixed_dot`` fixes for the LSTM). The ring/blockwise
+    paths hand-write their backward and never AD through these; full and
+    Ulysses attention rely on them. Each returned cotangent is cast to its
+    PRIMAL's dtype (JAX's own transpose convention) so the chain upstream
+    — e.g. the Q/K/V projection backward against bf16 weights — stays
+    same-dtype too. f32 inputs are bit-identical to the plain einsum.
+
+    ``da_spec`` contracts (g, b) -> da; ``db_spec`` contracts (a, g) when
+    ``db_primal_first`` else (g, a) -> db. Accumulation is f32 throughout.
+    """
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def op(a, b, dtype):
+        return jnp.einsum(
+            spec, a.astype(dtype), b.astype(dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    def fwd(a, b, dtype):
+        # zero-dim dtype tokens: residual pytree leaves must be arrays, and
+        # bwd needs the PRIMAL dtypes to cast the cotangents back
+        return op(a, b, dtype), (
+            a.astype(dtype), b.astype(dtype),
+            jnp.zeros((), a.dtype), jnp.zeros((), b.dtype),
+        )
+
+    def bwd(dtype, res, g):
+        ad, bd, a_tok, b_tok = res
+        gd = g.astype(dtype)
+        da = jnp.einsum(da_spec, gd, bd, preferred_element_type=jnp.float32)
+        db_ops = (ad, gd) if db_primal_first else (gd, ad)
+        db = jnp.einsum(db_spec, *db_ops, preferred_element_type=jnp.float32)
+        return da.astype(a_tok.dtype), db.astype(b_tok.dtype)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+# scores = einsum('bqhd,bkhd->bhqk', q, k)
+_qk_scores_dot = _make_mp_einsum(
+    "bqhd,bkhd->bhqk", "bhqk,bkhd->bqhd", "bhqk,bqhd->bkhd",
+    db_primal_first=False,
+)
+# out = einsum('bhqk,bkhd->bqhd', p, v); dp stays f32 automatically
+# (p's primal dtype is f32 — softmax statistics are always f32).
+_pv_dot = _make_mp_einsum(
+    "bhqk,bkhd->bqhd", "bqhd,bkhd->bhqk", "bhqk,bqhd->bkhd",
+    db_primal_first=True,
+)
 
 
 def _online_update(o, m, l, scores, v_blk):
@@ -107,8 +173,12 @@ def _online_update(o, m, l, scores, v_blk):
     alpha = jnp.exp(m - m_new)  # rescale of previous accumulators
     p = jnp.exp(scores - m_new[..., None])
     l_new = l * alpha + p.sum(axis=-1)
+    cd = _contract_dtype(v_blk)
     o_new = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v_blk, preferred_element_type=jnp.float32
+        "bhqk,bkhd->bqhd",
+        p.astype(cd),
+        v_blk.astype(cd),
+        preferred_element_type=jnp.float32,
     )
     return o_new, m_new, l_new
 
@@ -208,10 +278,15 @@ def _ring_vjp_bwd(axis_name, causal, res, do):
     scale = 1.0 / np.sqrt(q.shape[-1])
     do32 = do.astype(jnp.float32)
     out32 = out.astype(jnp.float32)
-    q32 = q.astype(jnp.float32)
+    # Contraction operand dtype: bf16 inputs keep the backward's four big
+    # per-block matmuls on the MXU fast path (f32 accumulation; ds/p/delta
+    # elementwise math stays f32). f32 inputs: all-f32, as before.
+    cd = _contract_dtype(q)
+    qc = q.astype(cd)
+    doc = do.astype(cd)
     # delta_i = rowsum(dO * O): (B, Tq, H) -> (B, H, Tq)
     delta = (do32 * out32).sum(axis=-1).transpose(0, 2, 1)
-    dq = jnp.zeros_like(q32)
+    dq = jnp.zeros_like(q, dtype=jnp.float32)
     dk = jnp.zeros_like(k, dtype=jnp.float32)
     dv = jnp.zeros_like(v, dtype=jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -230,23 +305,21 @@ def _ring_vjp_bwd(axis_name, causal, res, do):
             jnp.exp(scores - lse[..., None]),
         )
         dv_blk = dv_blk + jnp.einsum(
-            "bhqk,bqhd->bkhd", p, do32, preferred_element_type=jnp.float32
+            "bhqk,bqhd->bkhd", p.astype(cd), doc,
+            preferred_element_type=jnp.float32,
         )
         dp = jnp.einsum(
-            "bqhd,bkhd->bhqk",
-            do32,
-            v_blk.astype(jnp.float32),
+            "bqhd,bkhd->bhqk", doc, v_blk.astype(cd),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[..., None]) * jnp.float32(scale)
         dq = dq + jnp.einsum(
-            "bhqk,bkhd->bqhd",
-            ds,
-            k_blk.astype(jnp.float32),
+            "bhqk,bkhd->bqhd", ds.astype(cd), k_blk.astype(cd),
             preferred_element_type=jnp.float32,
         )
         dk_blk = dk_blk + jnp.einsum(
-            "bhqk,bqhd->bkhd", ds, q32, preferred_element_type=jnp.float32
+            "bhqk,bqhd->bkhd", ds.astype(cd), qc,
+            preferred_element_type=jnp.float32,
         )
         k_blk, v_blk, k_pos, k_seg, dk_blk, dv_blk = jax.tree_util.tree_map(
             lambda x: jax.lax.ppermute(x, axis_name, perm),
@@ -304,7 +377,7 @@ def ulysses_attention(
         qh, kh, pos_full, pos_full, seg_full, seg_full, scale, causal
     )
     p = jax.nn.softmax(scores, axis=-1)
-    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    oh = _pv_dot(p, vh, _contract_dtype(vh)).astype(qh.dtype)
 
     # back: (B, n*Tl, H/n, D) -> (B, Tl, H, D), the exact inverse exchange.
     return jax.lax.all_to_all(
@@ -333,7 +406,9 @@ def full_attention(
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = _masked_block_scores(q, k, q_pos, q_pos, seg, seg, scale, causal)
     p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # Output in q.dtype, matching ring/blockwise (which cast their f32
+    # accumulators back); for f32 inputs this is exactly the old behavior.
+    return _pv_dot(p, v, _contract_dtype(v)).astype(q.dtype)
 
 
 # ------------------------------------------------------- blockwise (1 chip)
@@ -449,6 +524,9 @@ def _blockwise_vjp_bwd(causal, block, res, do):
     nb = T // block
     scale = 1.0 / np.sqrt(D)
     do32 = do.astype(jnp.float32)
+    # See the ring backward: contraction operands in the input dtype (bf16
+    # fast path), f32 accumulation, f32 elementwise.
+    cd = _contract_dtype(q)
     delta = (do32 * out.astype(jnp.float32)).sum(axis=-1)  # (B, T, H)
     kb = (
         _split_blocks(k, nb), _split_blocks(v, nb),
@@ -458,8 +536,8 @@ def _blockwise_vjp_bwd(causal, block, res, do):
 
     def q_body(carry, xs):
         dk, dv = carry
-        q_blk, qpos, qseg, do_blk, lse, delta_blk = xs
-        q32 = q_blk.astype(jnp.float32)
+        q_blk, qpos, qseg, doc, lse, delta_blk = xs  # doc pre-cast to cd
+        qc = q_blk.astype(cd)
 
         def k_body(inner, ks):
             dq_blk, dk, dv = inner
@@ -471,19 +549,21 @@ def _blockwise_vjp_bwd(causal, block, res, do):
                 scores <= _NEG_INF * 0.5, 0.0, jnp.exp(scores - lse[..., None])
             )
             dv_c = jnp.einsum(
-                "bhqk,bqhd->bkhd", p, do_blk, preferred_element_type=jnp.float32
+                "bhqk,bqhd->bkhd", p.astype(cd), doc,
+                preferred_element_type=jnp.float32,
             )
             dp = jnp.einsum(
-                "bqhd,bkhd->bhqk", do_blk, v_blk.astype(jnp.float32),
+                "bqhd,bkhd->bhqk", doc, v_blk.astype(cd),
                 preferred_element_type=jnp.float32,
             )
             ds = p * (dp - delta_blk[..., None]) * jnp.float32(scale)
             dq_blk = dq_blk + jnp.einsum(
-                "bhqk,bkhd->bqhd", ds, k_blk.astype(jnp.float32),
+                "bhqk,bkhd->bqhd", ds.astype(cd), k_blk.astype(cd),
                 preferred_element_type=jnp.float32,
             )
             dk_c = jnp.einsum(
-                "bhqk,bqhd->bkhd", ds, q32, preferred_element_type=jnp.float32
+                "bhqk,bqhd->bkhd", ds.astype(cd), qc,
+                preferred_element_type=jnp.float32,
             )
             start = kidx * block
             dk = jax.lax.dynamic_update_slice_in_dim(
@@ -500,7 +580,7 @@ def _blockwise_vjp_bwd(causal, block, res, do):
         (dq_blk, dk, dv), _ = jax.lax.scan(k_body, (dq_blk, dk, dv), kb)
         return (dk, dv), dq_blk
 
-    do_b = _split_blocks(do32, nb)
+    do_b = _split_blocks(do.astype(cd), nb)
     (dk, dv), dq_b = jax.lax.scan(
         q_body,
         (jnp.zeros_like(k, dtype=jnp.float32), jnp.zeros_like(v, dtype=jnp.float32)),
