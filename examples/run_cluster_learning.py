@@ -59,6 +59,11 @@ def main() -> None:
     p.add_argument("--no-anneal", action="store_true")
     p.add_argument("--worker-step-sleep", type=float, default=0.02)
     p.add_argument(
+        "--learner-chain", type=int, default=1,
+        help="updates per dispatched learner program (Config.learner_chain); "
+        "the learner accumulates K consumed batches per dispatch",
+    )
+    p.add_argument(
         "--value-clip", type=float, nargs=2, default=None,
         metavar=("LO", "HI"),
         help="bounded-return V-trace value clamp (Config.value_target_clip); "
@@ -133,6 +138,7 @@ def main() -> None:
             # cap). Near-empty queues keep the behavior policy fresh.
             worker_step_sleep=args.worker_step_sleep,
             worker_num_envs=args.num_envs,
+            learner_chain=args.learner_chain,
             learner_device="cpu",  # deterministic on shared hosts; the
             # real-TPU topology is separately recorded in RUN_LOCAL_TPU_r03.md
             rollout_lag_sec=5.0,
@@ -194,6 +200,7 @@ def main() -> None:
         wallclock_s=round(wallclock, 1),
         workers=args.workers,
         num_envs_per_worker=args.num_envs,
+        learner_chain=args.learner_chain,
         seed=args.seed,
         target=args.target,
         solved=(fleet_max is not None and fleet_max >= args.target),

@@ -199,6 +199,30 @@ class TestMixedPrecisionStructure:
         mixed = [(a, b) for a, b in dots if a != b]
         assert not mixed, f"mixed-dtype dots: {mixed}"
 
+    def test_bf16_ring_sp_train_step_has_no_mixed_dtype_dots(self, devices):
+        """Same invariant through the sequence-parallel path: the ring
+        attention shard_map island and its hand-written VJP (whose einsums
+        are cast manually, not via _make_mp_einsum) — the traversal
+        descends into shard_map/custom-VJP sub-jaxprs."""
+        from tests.conftest import dot_operand_dtypes
+        from tests.test_algos import make_batch
+        from tpu_rl.parallel import make_sp_mesh, make_sp_train_step
+
+        cfg = _tf_config(
+            algo="PPO", attention_impl="ring", compute_dtype="bfloat16",
+            mesh_data=2, mesh_seq=4,
+        )
+        mesh = make_sp_mesh(2, 4)
+        fam, state, step = get_algo("PPO").build(
+            cfg, jax.random.key(0), mesh=mesh
+        )
+        batch = make_batch(cfg, fam)
+        jaxpr = jax.make_jaxpr(step)(state, batch, jax.random.key(1))
+        dots = dot_operand_dtypes(jaxpr)
+        assert dots, "no dots found — jaxpr traversal broken?"
+        mixed = [(a, b) for a, b in dots if a != b]
+        assert not mixed, f"mixed-dtype dots: {mixed}"
+
 
 class TestTransformerActing:
     def test_act_carry_protocol(self, rng):
