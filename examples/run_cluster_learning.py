@@ -64,6 +64,19 @@ def main() -> None:
         "the learner accumulates K consumed batches per dispatch",
     )
     p.add_argument(
+        "--k-epoch", type=int, default=1,
+        help="optimizer epochs per batch (Config.K_epoch); V-MPO's inline "
+        "recipe needs 4 — its KL Lagrange constraint is inactive at 1 "
+        "(behavior == target at the only epoch, examples/run_baselines.py)",
+    )
+    p.add_argument(
+        "--keep-window-carry", action="store_true",
+        help="train from the actor-stored recurrent carries "
+        "(Config.zero_window_carry=False, reference parity) instead of the "
+        "R2D2-style zero-init that the IMPALA lag diagnosis made default "
+        "here",
+    )
+    p.add_argument(
         "--value-clip", type=float, nargs=2, default=None,
         metavar=("LO", "HI"),
         help="bounded-return V-trace value clamp (Config.value_target_clip); "
@@ -126,7 +139,7 @@ def main() -> None:
             # hallucination (mean V > discounted cap) -> persistent negative
             # advantages -> entropy ratchets to exactly 0 regardless of the
             # entropy bonus (collapse observed at coef 0.001, 0.01 AND 0.05).
-            zero_window_carry=True,
+            zero_window_carry=not args.keep_window_carry,
             rho_bar=args.rho_bar,
             rho_min=args.rho_min,
             # Throttle the fleet to just above the learner's consumption
@@ -139,6 +152,7 @@ def main() -> None:
             worker_step_sleep=args.worker_step_sleep,
             worker_num_envs=args.num_envs,
             learner_chain=args.learner_chain,
+            K_epoch=args.k_epoch,
             learner_device="cpu",  # deterministic on shared hosts; the
             # real-TPU topology is separately recorded in RUN_LOCAL_TPU_r03.md
             rollout_lag_sec=5.0,
@@ -201,6 +215,8 @@ def main() -> None:
         workers=args.workers,
         num_envs_per_worker=args.num_envs,
         learner_chain=args.learner_chain,
+        k_epoch=args.k_epoch,
+        zero_window_carry=not args.keep_window_carry,
         seed=args.seed,
         target=args.target,
         solved=(fleet_max is not None and fleet_max >= args.target),

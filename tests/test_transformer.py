@@ -206,7 +206,7 @@ class TestMixedPrecisionStructure:
         descends into shard_map/custom-VJP sub-jaxprs."""
         from tests.conftest import dot_operand_dtypes
         from tests.test_algos import make_batch
-        from tpu_rl.parallel import make_sp_mesh, make_sp_train_step
+        from tpu_rl.parallel import make_sp_mesh
 
         cfg = _tf_config(
             algo="PPO", attention_impl="ring", compute_dtype="bfloat16",
