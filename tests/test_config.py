@@ -40,6 +40,26 @@ def test_sac_reference_alpha_rejects_explicit_target_entropy():
     Config.from_dict({"algo": "SAC", "target_entropy": -1.0})
 
 
+def test_zero_window_carry_warns_for_gae_algos():
+    """The five-run carry-rule experiment (CLUSTER_R5_PPO.md): zeroed
+    training carries cap/flatline the GAE-based algorithms under async lag
+    while rescuing V-trace. Config warns on the measured-bad combination
+    and stays silent on the measured-good ones."""
+    import warnings
+
+    for algo, kw in (
+        ("PPO", {}),
+        ("V-MPO", {}),
+        ("PPO-Continuous", {"is_continuous": True, "action_space": 1}),
+    ):
+        with pytest.warns(UserWarning, match="GAE-based"):
+            Config.from_dict({"algo": algo, "zero_window_carry": True, **kw})
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        Config.from_dict({"algo": "IMPALA", "zero_window_carry": True})
+        Config.from_dict({"algo": "PPO", "zero_window_carry": False})
+
+
 def test_sequence_parallel_constraints():
     with pytest.raises(AssertionError):
         Config.from_dict({"mesh_seq": 2, "model": "lstm"})

@@ -301,6 +301,27 @@ class Config:
                 "has a continuous action space; use PPO-Continuous or "
                 "SAC-Continuous"
             )
+        if self.zero_window_carry and self.algo.removesuffix(
+            "-Continuous"
+        ) in ("PPO", "V-MPO"):  # PPO-Continuous shares ppo.td_target_and_gae
+            # Measured, five-run discriminating experiment
+            # (CLUSTER_R5_VMPO.md / CLUSTER_R5_PPO.md): the window-carry
+            # policy follows the advantage estimator. Zero-init rescues
+            # V-trace (IMPALA) from stale-carry value hallucination under
+            # async lag, but GAE has no per-step importance correction —
+            # the carry-induced value bias shifts every advantage, capping
+            # distributed PPO at fleet mean ~25 and flatlining V-MPO at
+            # random, while stored carries solved both. Warn, don't raise:
+            # single-process/inline training is unaffected by lag.
+            import warnings
+
+            warnings.warn(
+                f"zero_window_carry=True with {self.algo}: GAE-based "
+                "algorithms measurably fail under async lag with zeroed "
+                "training carries (capped/flat fleet reward); use stored "
+                "carries (zero_window_carry=False) for PPO/V-MPO — "
+                "zero-init is the V-trace/IMPALA fix (CLUSTER_R5_PPO.md)",
+            )
         assert self.learner_chain >= 1, self.learner_chain
         if self.learner_chain > 1:
             # Chained dispatch rides make_parallel_train_step's scan; the
