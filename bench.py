@@ -27,6 +27,10 @@ only for a full run on an accelerator. CPU-backend runs write
 ``bench_results.cpu.json`` and ``TPU_RL_BENCH_LIGHT`` (partial @ref-only
 matrix) writes ``bench_results.light.json``, so the committed on-chip table
 is never clobbered by fallback or partial numbers.
+
+``TPU_RL_BENCH_E2E=1 python bench.py`` runs the e2e FEED comparison instead:
+the production LearnerService through the real shm path, synchronous vs
+prefetched data plane (``run_e2e_compare`` -> ``bench_e2e_feed[.cpu].json``).
 """
 
 from __future__ import annotations
@@ -382,6 +386,195 @@ ZERO_HEADLINE = {
 }
 
 
+# --------------------------------------------------------------- e2e feed
+def _steady_tps(timer, name: str = "learner-throughput") -> float | None:
+    """Steady-state transitions/sec from the service's windowed timer with
+    the FIRST dispatch dropped: it carries the jit compile (seconds against
+    sub-ms steps) and at e2e-bench dispatch counts it would dominate the
+    window mean. Both feed variants pay the same compile, so dropping it
+    from both keeps the comparison honest."""
+    q = list(timer.throughput.get(name, ()))
+    if len(timer.elapsed.get(name, ())) >= 2 and len(q) >= 2:
+        q = q[1:]
+    return sum(q) / len(q) if q else None
+
+
+def e2e_learner_row(
+    updates: int = 2048,
+    chain: int = 16,
+    feeders: int = 4,
+    publish_interval: int = 256,
+    prefetch: int = 2,
+    model_port: int = 29890,
+    batch_size: int = 128,
+    seq_len: int = 5,
+    hidden_size: int = 64,
+) -> dict:
+    """END-TO-END learner FPS through the REAL shm feed: feeder threads put
+    windows into an OnPolicyStore while the production LearnerService
+    consumes, assembles, places, and train-steps them — every batch crosses
+    host shm -> device exactly as in a deployment (unlike the @ref rows'
+    pre-placed device batches). ``prefetch`` selects the feed
+    (``Config.learner_prefetch``): > 0 pipelines the data plane, 0 is the
+    synchronous serial baseline. Shared by ``run_e2e_compare`` below and
+    ``examples/run_tpu_e2e_learner.py``."""
+    import threading
+
+    from tpu_rl.config import Config
+    from tpu_rl.data.layout import BatchLayout
+    from tpu_rl.data.shm_ring import OnPolicyStore, alloc_handles
+    from tpu_rl.runtime.learner_service import LearnerService
+    from tpu_rl.types import BATCH_FIELDS
+
+    cfg = Config.from_dict(
+        dict(
+            algo="IMPALA", batch_size=batch_size, seq_len=seq_len,
+            hidden_size=hidden_size, obs_shape=(4,), action_space=2,
+            learner_chain=chain, learner_prefetch=prefetch,
+            loss_log_interval=10**9,
+        )
+    )
+    layout = BatchLayout.from_config(cfg)
+    handles = alloc_handles(layout, capacity=cfg.batch_size)
+
+    # Pre-generated window pool: the feeders only memcpy, so the feed rate
+    # measures the shm path, not RNG.
+    rng = np.random.default_rng(0)
+    pool = []
+    for _ in range(64):
+        w = {}
+        for f in BATCH_FIELDS:
+            shape = (layout.seq_len, layout.width(f))
+            if f == "act":
+                w[f] = rng.integers(0, 2, size=shape).astype(np.float32)
+            elif f == "is_fir":
+                a = np.zeros(shape, np.float32)
+                a[0] = 1.0
+                w[f] = a
+            elif f == "log_prob":
+                w[f] = np.full(shape, -0.7, np.float32)
+            else:
+                w[f] = rng.standard_normal(shape).astype(np.float32) * 0.1
+        pool.append(w)
+
+    stop = threading.Event()
+    puts = [0] * feeders
+    put_blocked = [0] * feeders
+    # OnPolicyStore.put is single-writer; serialize feeders so N threads
+    # emulate N producers funneling through one writer.
+    put_lock = threading.Lock()
+
+    def feed(k: int) -> None:
+        store = OnPolicyStore(handles, layout)  # per-thread views
+        i = k
+        while not stop.is_set():
+            with put_lock:
+                ok = store.put(pool[i % len(pool)])
+            if ok:
+                puts[k] += 1
+                i += 1
+            else:
+                put_blocked[k] += 1
+                time.sleep(0)  # store full: learner is the bottleneck
+
+    threads = [
+        threading.Thread(target=feed, args=(k,), daemon=True)
+        for k in range(feeders)
+    ]
+    for t in threads:
+        t.start()
+
+    svc = LearnerService(
+        cfg, handles, model_port=model_port, stop_event=stop,
+        max_updates=updates, publish_interval=publish_interval,
+    )
+    t0 = time.perf_counter()
+    svc.run()
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    done = updates // max(1, chain) * max(1, chain)
+    transitions = done * cfg.batch_size * cfg.seq_len
+    total_puts = sum(puts)
+    steady = _steady_tps(svc.timer)
+    tmr = svc.timer
+    ms = lambda name: (  # noqa: E731 — row-local shorthand
+        round(tmr.mean_elapsed(name) * 1e3, 3)
+        if tmr.mean_elapsed(name) is not None else None
+    )
+    depth = tmr.mean_gauge("learner-queue-depth")
+    return dict(
+        device_kind=jax.devices()[0].device_kind,
+        feed="prefetch" if prefetch > 0 else "sync",
+        prefetch_depth=prefetch,
+        algo=cfg.algo, batch=cfg.batch_size, seq=cfg.seq_len,
+        hidden=cfg.hidden_size, chain=chain, feeders=feeders,
+        updates=done, seconds=round(elapsed, 2),
+        e2e_learner_tps=round(transitions / elapsed, 1),
+        e2e_learner_tps_steady=(
+            round(steady, 1) if steady is not None else None
+        ),
+        queue_wait_ms=ms("learner-queue-wait-time"),
+        batching_ms=ms("learner-batching-time"),
+        step_ms=ms("learner-step-time"),
+        queue_depth_mean=round(depth, 2) if depth is not None else None,
+        feed_windows_per_s=round(total_puts / elapsed, 1),
+        feed_tps=round(total_puts * cfg.seq_len / elapsed, 1),
+        feed_blocked_ratio=round(
+            sum(put_blocked) / max(1, sum(put_blocked) + total_puts), 3
+        ),
+    )
+
+
+def run_e2e_compare(
+    updates: int | None = None,
+    chain: int | None = None,
+    feeders: int = 4,
+    out_path: str | None = None,
+) -> dict:
+    """Sync vs prefetched feed, same workload, one process: the A/B row for
+    the pipelined data plane. With prefetch the per-dispatch critical path
+    is queue-wait + step (batching overlaps the device), so
+    ``queue_wait_ms`` << ``batching_ms`` is the overlap made visible, and
+    ``speedup`` >= 1.0 is the acceptance bar. CPU-backend runs use a
+    smaller budget and write ``bench_e2e_feed.cpu.json`` (never clobbering
+    the on-chip record)."""
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if updates is None:
+        updates = 384 if on_cpu else 2048
+    if chain is None:
+        chain = 8 if on_cpu else 16
+    if out_path is None:
+        out_path = "bench_e2e_feed.cpu.json" if on_cpu else "bench_e2e_feed.json"
+    rows = []
+    for prefetch, port in ((0, 29890), (2, 29891)):
+        row = e2e_learner_row(
+            updates=updates, chain=chain, feeders=feeders,
+            prefetch=prefetch, model_port=port,
+        )
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    sync_row, pre_row = rows
+    # Compare steady windowed rates (first/compile dispatch dropped on both
+    # sides); fall back to wall-clock tps if a window is missing.
+    a = pre_row["e2e_learner_tps_steady"] or pre_row["e2e_learner_tps"]
+    b = sync_row["e2e_learner_tps_steady"] or sync_row["e2e_learner_tps"]
+    result = {
+        "metric": "e2e learner FPS, prefetched vs synchronous feed",
+        "device_kind": jax.devices()[0].device_kind,
+        "speedup": round(a / b, 3) if b else None,
+        "prefetch_tps_steady": a,
+        "sync_tps_steady": b,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
 def _accelerator_reachable(timeout_s: float = 120.0) -> str | None:
     from tpu_rl.utils.platform import accelerator_reachable
 
@@ -442,6 +635,13 @@ def last_good_onchip(path: str | None = None) -> dict | None:
 
 
 if __name__ == "__main__":
+    if os.environ.get("TPU_RL_BENCH_E2E"):
+        # e2e feed A/B mode: sync vs prefetched LearnerService through the
+        # real shm path, on whatever backend jax resolved (set
+        # JAX_PLATFORMS=cpu for a host run). Separate from the step-level
+        # matrix below: this measures the data plane, that measures the chip.
+        print(json.dumps(run_e2e_compare()))
+        sys.exit(0)
     if os.environ.get("TPU_RL_BENCH_CHILD"):
         failure = None
     elif os.environ.get("TPU_RL_BENCH_SIMULATE_OUTAGE"):

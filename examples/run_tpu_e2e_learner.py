@@ -1,5 +1,5 @@
 """On-chip END-TO-END learner FPS: the production LearnerService fed through
-the REAL shared-memory path (OnPolicyStore put -> consume -> _assemble ->
+the REAL shared-memory path (OnPolicyStore put -> consume -> assemble ->
 chained dispatch), not a synthetic pre-placed device batch.
 
 This is the honest counterpart to bench.py's @ref rows (which time the
@@ -7,12 +7,20 @@ compiled step on a device-resident batch): here every update's batch crosses
 host shm -> device, exactly like a deployment. If the host feed cannot keep
 the chip busy, that gap IS the result — both rates are reported.
 
+The harness itself lives in ``bench.e2e_learner_row`` (shared with the
+``TPU_RL_BENCH_E2E`` A/B mode); this wrapper adds the CLI. ``--feed``
+selects the data plane: ``prefetch`` (pipelined feeder thread,
+``Config.learner_prefetch`` depth), ``sync`` (the serial baseline,
+``learner_prefetch=0``), or ``both`` (run each and report the speedup —
+the overlap A/B on real hardware).
+
 The reference's corresponding instrument is the learner-throughput timer
 around its sample+update loop (``/root/reference/utils/utils.py:167-189``).
 
 Run on the TPU host (learner owns the chip; feeders are host threads):
   PYTHONPATH=/root/repo:/root/.axon_site python examples/run_tpu_e2e_learner.py \
-      [--updates 2048] [--chain 16] [--feeders 4] [--out bench_e2e_learner.json]
+      [--updates 2048] [--chain 16] [--feeders 4] [--feed both] \
+      [--prefetch-depth 2] [--out bench_e2e_learner.json]
 """
 
 from __future__ import annotations
@@ -21,12 +29,8 @@ import argparse
 import json
 import os
 import sys
-import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np
 
 
 def main() -> None:
@@ -35,115 +39,34 @@ def main() -> None:
     p.add_argument("--chain", type=int, default=16)
     p.add_argument("--feeders", type=int, default=4)
     p.add_argument("--publish-interval", type=int, default=256)
+    p.add_argument(
+        "--feed", choices=("prefetch", "sync", "both"), default="prefetch",
+        help="data plane: pipelined feed, serial baseline, or A/B both",
+    )
+    p.add_argument("--prefetch-depth", type=int, default=2)
     p.add_argument("--out", default="bench_e2e_learner.json")
     args = p.parse_args()
 
-    from tpu_rl.config import Config
-    from tpu_rl.data.layout import BatchLayout
-    from tpu_rl.data.shm_ring import OnPolicyStore, alloc_handles
-    from tpu_rl.runtime.learner_service import LearnerService
-    from tpu_rl.types import BATCH_FIELDS
+    from bench import e2e_learner_row, run_e2e_compare
 
-    cfg = Config.from_dict(
-        dict(
-            algo="IMPALA", batch_size=128, seq_len=5, hidden_size=64,
-            obs_shape=(4,), action_space=2, learner_chain=args.chain,
-            loss_log_interval=10**9,
+    if args.feed == "both":
+        result = run_e2e_compare(
+            updates=args.updates, chain=args.chain, feeders=args.feeders,
+            out_path=args.out,
         )
+        print(json.dumps(result), flush=True)
+        print(f"wrote {args.out}", flush=True)
+        return
+
+    prefetch = args.prefetch_depth if args.feed == "prefetch" else 0
+    row = e2e_learner_row(
+        updates=args.updates, chain=args.chain, feeders=args.feeders,
+        publish_interval=args.publish_interval, prefetch=prefetch,
     )
-    layout = BatchLayout.from_config(cfg)
-    handles = alloc_handles(layout, capacity=cfg.batch_size)
-
-    # Pre-generate a pool of synthetic windows (field -> (seq, width)); the
-    # feeders only memcpy, so the feed rate measures the shm path, not RNG.
-    rng = np.random.default_rng(0)
-    pool = []
-    for j in range(64):
-        w = {}
-        for f in BATCH_FIELDS:
-            shape = (layout.seq_len, layout.width(f))
-            if f == "act":
-                w[f] = rng.integers(0, 2, size=shape).astype(np.float32)
-            elif f == "is_fir":
-                a = np.zeros(shape, np.float32)
-                a[0] = 1.0
-                w[f] = a
-            elif f == "log_prob":
-                w[f] = np.full(shape, -0.7, np.float32)
-            else:
-                w[f] = rng.standard_normal(shape).astype(np.float32) * 0.1
-        pool.append(w)
-
-    stop = threading.Event()
-    puts = [0] * args.feeders
-    put_blocked = [0] * args.feeders
-    # OnPolicyStore.put is single-writer (slot reserve and slot write are
-    # separate critical sections); serialize feeders so N threads emulate N
-    # producers funneling through one writer, never a torn/lost window.
-    put_lock = threading.Lock()
-
-    def feed(k: int) -> None:
-        store = OnPolicyStore(handles, layout)  # per-thread views
-        i = k
-        while not stop.is_set():
-            with put_lock:
-                ok = store.put(pool[i % len(pool)])
-            if ok:
-                puts[k] += 1
-                i += 1
-            else:
-                put_blocked[k] += 1
-                time.sleep(0)  # store full: learner is the bottleneck
-
-    threads = [
-        threading.Thread(target=feed, args=(k,), daemon=True)
-        for k in range(args.feeders)
-    ]
-    for t in threads:
-        t.start()
-
-    svc = LearnerService(
-        cfg,
-        handles,
-        model_port=29890,
-        stop_event=stop,
-        max_updates=args.updates,
-        publish_interval=args.publish_interval,
-    )
-    t0 = time.perf_counter()
-    svc.run()
-    elapsed = time.perf_counter() - t0
-    stop.set()
-    for t in threads:
-        t.join(timeout=10)
-
-    import jax
-
-    updates = args.updates // max(1, args.chain) * max(1, args.chain)
-    transitions = updates * cfg.batch_size * cfg.seq_len
-    total_puts = sum(puts)
-    # Steady-state rate from the service's own windowed timer (last 100
-    # dispatches; excludes idle polls, dilutes first-dispatch compile).
-    steady = svc.timer.mean_throughput("learner-throughput")
-    row = dict(
-        device_kind=jax.devices()[0].device_kind,
-        algo=cfg.algo, batch=cfg.batch_size, seq=cfg.seq_len,
-        hidden=cfg.hidden_size, chain=args.chain, feeders=args.feeders,
-        updates=updates, seconds=round(elapsed, 2),
-        e2e_learner_tps=round(transitions / elapsed, 1),
-        e2e_learner_tps_steady=(
-            round(steady, 1) if steady is not None else None
-        ),
-        feed_windows_per_s=round(total_puts / elapsed, 1),
-        feed_tps=round(total_puts * cfg.seq_len / elapsed, 1),
-        feed_blocked_ratio=round(
-            sum(put_blocked) / max(1, sum(put_blocked) + total_puts), 3
-        ),
-        note=(
-            "e2e through the real shm feed (put->consume->_assemble->chained "
-            "dispatch); feed_blocked_ratio ~1 means the chip outran the host "
-            "feed's spare capacity, ~0 means the feed was the bottleneck"
-        ),
+    row["note"] = (
+        "e2e through the real shm feed (put->consume->assemble->chained "
+        "dispatch); feed_blocked_ratio ~1 means the chip outran the host "
+        "feed's spare capacity, ~0 means the feed was the bottleneck"
     )
     print(json.dumps(row), flush=True)
     with open(args.out, "w") as f:

@@ -72,3 +72,15 @@ def test_sequence_parallel_constraints():
                 "seq_len": 8,
             }
         )
+
+
+def test_prefetch_and_ratio_knob_validation():
+    """Pipelined-feed knobs fail fast: negative prefetch depth and
+    non-positive update:data ratios are misconfigurations."""
+    Config.from_dict({"learner_prefetch": 0})  # synchronous A/B switch
+    Config.from_dict({"learner_prefetch": 4})
+    Config.from_dict({"algo": "SAC", "max_update_data_ratio": 0.25})
+    with pytest.raises(AssertionError, match="learner_prefetch"):
+        Config.from_dict({"learner_prefetch": -1})
+    with pytest.raises(AssertionError, match="max_update_data_ratio"):
+        Config.from_dict({"algo": "SAC", "max_update_data_ratio": 0.0})

@@ -198,3 +198,24 @@ def test_vmem_budget_fallback():
     assert batch_tile(128, 4096, 256) is None
     # ...as must a workload whose weights alone bust VMEM.
     assert batch_tile(8, 4096, 2048) is None
+
+
+def test_mixed_dot_rejects_non_matrix_operands():
+    """mixed_dot's custom VJP transposes residuals with .T — valid for
+    matrices only. Batched or 1-D operands must fail loudly at the primal
+    (a silent wrong-gradient contraction is the failure mode)."""
+    from tpu_rl.ops.pallas_lstm import mixed_dot
+
+    a2 = jnp.ones((4, 8))
+    b2 = jnp.ones((8, 3))
+    out = mixed_dot(a2, b2)  # the supported shape still works
+    assert out.shape == (4, 3) and out.dtype == jnp.float32
+    # gradients flow through the 2-D path
+    g = jax.grad(lambda a: mixed_dot(a, b2).sum())(a2)
+    assert g.shape == a2.shape
+    with pytest.raises(ValueError, match="2-D"):
+        mixed_dot(jnp.ones((2, 4, 8)), jnp.ones((8, 3)))  # batched lhs
+    with pytest.raises(ValueError, match="2-D"):
+        mixed_dot(jnp.ones((8,)), b2)  # vector lhs
+    with pytest.raises(ValueError, match="2-D"):
+        jax.jit(mixed_dot)(a2, jnp.ones((2, 8, 3)))  # under tracing too

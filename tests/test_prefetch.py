@@ -1,0 +1,319 @@
+"""Pipelined learner feed: the PrefetchPipeline contract (ordering, clean
+shutdown, error propagation), the update:data ratio gate, and bit-exact
+equivalence of the pipelined and synchronous LearnerService paths through the
+real shm store (ISSUE: overlap the host data plane with device compute)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.data.prefetch import PrefetchPipeline, SynchronousFeed, UpdateRatioGate
+
+
+# ---------------------------------------------------------------- pipeline
+@pytest.mark.timeout(60)
+def test_prefetch_ordering_and_no_batch_loss():
+    """Every fetched batch reaches the consumer, exactly once, in fetch
+    order — the no-loss/no-reorder half of the pipeline contract."""
+    n = 50
+    counter = iter(range(n))
+
+    def fetch():
+        return next(counter, None)
+
+    pipe = PrefetchPipeline(fetch, lambda raws: list(raws), chain=1, depth=2)
+    got = []
+    deadline = time.time() + 30
+    while len(got) < n and time.time() < deadline:
+        item = pipe.get(timeout=0.05)
+        if item is not None:
+            got.append(item[0][0])
+    pipe.close()
+    assert got == list(range(n))
+    assert pipe.dispatched == n
+
+
+@pytest.mark.timeout(60)
+def test_prefetch_chain_accumulation():
+    """chain=K hands assemble exactly K raws per dispatch, in order."""
+    counter = iter(range(12))
+
+    def fetch():
+        return next(counter, None)
+
+    pipe = PrefetchPipeline(fetch, lambda raws: list(raws), chain=3, depth=2)
+    got = []
+    deadline = time.time() + 30
+    while len(got) < 4 and time.time() < deadline:
+        item = pipe.get(timeout=0.05)
+        if item is not None:
+            got.append(item[0])
+    pipe.close()
+    assert got == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9, 10, 11]]
+
+
+@pytest.mark.timeout(60)
+def test_prefetch_close_joins_blocked_feeder():
+    """close() must terminate the feeder even while it is blocked putting
+    into a FULL queue (nobody consuming) — the shutdown-deadlock case."""
+    def fetch():
+        return 1
+
+    pipe = PrefetchPipeline(fetch, lambda raws: raws, chain=1, depth=1)
+    deadline = time.time() + 10
+    while pipe.qsize() < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert pipe.qsize() == 1  # feeder is now blocked on the next put
+    pipe.close(timeout=10)
+    assert not pipe._thread.is_alive()
+
+
+@pytest.mark.timeout(60)
+def test_prefetch_external_stop_event():
+    """The shared cluster stop event halts the feeder without close()."""
+    stop = threading.Event()
+    pipe = PrefetchPipeline(
+        lambda: 1, lambda raws: raws, chain=1, depth=1, stop_event=stop
+    )
+    stop.set()
+    deadline = time.time() + 10
+    while pipe._thread.is_alive() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not pipe._thread.is_alive()
+    pipe.close()
+
+
+@pytest.mark.timeout(60)
+def test_prefetch_feeder_exception_reraises_in_consumer():
+    """A feeder-thread exception must surface from get(), not hang."""
+    calls = {"n": 0}
+
+    def fetch():
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("store exploded")
+        return calls["n"]
+
+    pipe = PrefetchPipeline(fetch, lambda raws: raws[0], chain=1, depth=1)
+    seen_error = False
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            pipe.get(timeout=0.05)
+        except RuntimeError as e:
+            assert "store exploded" in str(e)
+            seen_error = True
+            break
+    assert seen_error
+    pipe.close()
+
+
+def test_prefetch_rejects_bad_depth():
+    with pytest.raises(ValueError):
+        PrefetchPipeline(lambda: None, lambda r: r, depth=0)
+
+
+# --------------------------------------------------------- synchronous feed
+def test_synchronous_feed_accumulates_chain_across_none():
+    """A starving store (fetch -> None) must preserve already-accumulated
+    chain members; the dispatch completes once the store recovers."""
+    seq = iter([10, None, 11, None, None, 12])
+
+    def fetch():
+        return next(seq, None)
+
+    feed = SynchronousFeed(fetch, lambda raws: list(raws), chain=3)
+    results = []
+    for _ in range(6):
+        item = feed.get()
+        if item is not None:
+            results.append(item[0])
+    assert results == [[10, 11, 12]]
+    feed.close()  # no-op, but part of the interface
+
+
+# ------------------------------------------------------------- ratio gate
+def test_update_ratio_gate_arithmetic():
+    gate = UpdateRatioGate(max_ratio=0.5)  # 1 update per 2 transitions
+    assert not gate.ready(0)  # no data yet: never update
+    assert gate.ready(2)
+    gate.note_fetched()
+    assert not gate.ready(2)  # 2nd update needs >= 4 transitions
+    assert not gate.ready(3)
+    assert gate.ready(4)
+    gate.note_fetched()
+    assert not gate.ready(4)
+    assert gate.ready(1000)  # plenty of headroom after a data burst
+
+
+def test_update_ratio_gate_rejects_nonpositive():
+    with pytest.raises(ValueError):
+        UpdateRatioGate(0.0)
+    with pytest.raises(ValueError):
+        UpdateRatioGate(-1.0)
+
+
+@pytest.mark.timeout(60)
+def test_learner_fetch_honors_ratio_gate_with_stubbed_store():
+    """LearnerService._make_fetch wires the gate for off-policy configs:
+    fetches stall at the ratio cap and resume as transitions arrive —
+    verified against a stubbed ReplayStore-shaped object."""
+    from tpu_rl.runtime.learner_service import LearnerService
+
+    cfg = small_config(
+        algo="SAC", batch_size=4, max_update_data_ratio=0.1,
+    )  # 1 update per 10 transitions
+
+    class StubStore:
+        def __init__(self):
+            self.transitions = 0
+            self.samples = 0
+
+        def transitions_received(self):
+            return self.transitions
+
+        def sample(self, batch, rng):
+            self.samples += 1
+            return {"stub": self.samples}
+
+    store = StubStore()
+    svc = LearnerService(cfg, handles=None, model_port=0)
+    fetch = svc._make_fetch(store, np.random.default_rng(0))
+
+    assert fetch() is None  # no data at all: gate holds
+    assert store.samples == 0
+
+    store.transitions = 25  # budget: floor(0.1 * 25) = 2 updates
+    assert fetch() == {"stub": 1}
+    assert fetch() == {"stub": 2}
+    assert fetch() is None  # cap reached; the store was NOT sampled
+    assert store.samples == 2
+
+    store.transitions = 30  # 3 updates earned now
+    assert fetch() == {"stub": 3}
+    assert fetch() is None
+
+
+@pytest.mark.timeout(60)
+def test_learner_fetch_no_gate_when_ratio_unset():
+    """max_update_data_ratio=None (default): off-policy fetch free-runs."""
+    from tpu_rl.runtime.learner_service import LearnerService
+
+    cfg = small_config(algo="SAC", batch_size=4)
+
+    class StubStore:
+        def transitions_received(self):  # pragma: no cover — must not be used
+            raise AssertionError("gateless fetch must not poll the odometer")
+
+        def sample(self, batch, rng):
+            return {"stub": 1}
+
+    svc = LearnerService(cfg, handles=None, model_port=0)
+    fetch = svc._make_fetch(StubStore(), np.random.default_rng(0))
+    assert svc._feed_gate is None
+    for _ in range(5):
+        assert fetch() == {"stub": 1}
+
+
+# ------------------------------------------------- service-level equivalence
+def _run_service_to_checkpoint(tmp_path, tag, port, prefetch, chain=2):
+    """Run a LearnerService through the REAL OnPolicyStore shm path on a
+    deterministic window stream; return the checkpointed final state."""
+    import jax
+
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.checkpoint import Checkpointer
+    from tpu_rl.data.layout import BatchLayout
+    from tpu_rl.data.shm_ring import OnPolicyStore, alloc_handles
+    from tpu_rl.runtime.learner_service import LearnerService
+    from tpu_rl.types import BATCH_FIELDS
+
+    n_updates, B = 4, 4
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        batch_size=B,
+        seq_len=5,
+        hidden_size=16,
+        learner_chain=chain,
+        learner_prefetch=prefetch,
+        learner_device="cpu",
+        result_dir=None,
+        model_dir=str(tmp_path / f"models_{tag}"),
+        model_save_interval=100,
+        loss_log_interval=1000,
+    )
+    layout = BatchLayout.from_config(cfg)
+    handles = alloc_handles(layout, capacity=B)
+    store = OnPolicyStore(handles, layout)
+
+    wrng = np.random.default_rng(7)
+    windows = []
+    for _ in range(n_updates * B):
+        w = {}
+        for f in BATCH_FIELDS:
+            shape = (layout.seq_len, layout.width(f))
+            if f == "act":
+                w[f] = wrng.integers(0, 2, size=shape).astype(np.float32)
+            elif f == "is_fir":
+                a = np.zeros(shape, np.float32)
+                a[0] = 1.0
+                w[f] = a
+            elif f == "log_prob":
+                w[f] = np.full(shape, -0.7, np.float32)
+            else:
+                w[f] = wrng.standard_normal(shape).astype(np.float32) * 0.1
+        windows.append(w)
+
+    def feed():
+        for w in windows:
+            while not store.put(w):
+                time.sleep(0.001)
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+    svc = LearnerService(
+        cfg, handles, model_port=port, stop_event=threading.Event(),
+        max_updates=n_updates, seed=0,
+    )
+    svc.run()
+    feeder.join(timeout=30)
+    assert not feeder.is_alive()
+
+    spec = get_algo(cfg.algo)
+    template = spec.build(cfg, jax.random.key(0))[1]
+    got, idx = Checkpointer(
+        str(tmp_path / f"models_{tag}"), cfg.algo
+    ).restore_latest(template)
+    assert idx == n_updates
+    return got, svc
+
+
+@pytest.mark.timeout(300)
+def test_pipelined_matches_synchronous_bit_exact(tmp_path):
+    """The acceptance bar: learner_prefetch=2 and learner_prefetch=0 produce
+    BIT-IDENTICAL final params on the same window stream — the pipeline
+    changes timing, never data, order, or the key schedule."""
+    import jax
+
+    sync_state, _ = _run_service_to_checkpoint(
+        tmp_path, "sync", port=29850, prefetch=0
+    )
+    pipe_state, pipe_svc = _run_service_to_checkpoint(
+        tmp_path, "pipe", port=29851, prefetch=2
+    )
+    want = jax.tree_util.tree_leaves(sync_state.params)
+    have = jax.tree_util.tree_leaves(pipe_state.params)
+    assert want and len(want) == len(have)
+    for a, b in zip(want, have):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # The new pipeline instrumentation must have populated its windows.
+    scalars = pipe_svc.timer.scalars()
+    assert "learner-queue-wait-time-elapsed-mean-sec" in scalars
+    assert "learner-batching-time-elapsed-mean-sec" in scalars
+    assert "learner-queue-depth-mean" in scalars
+    assert scalars["learner-throughput-transition-per-secs"] > 0

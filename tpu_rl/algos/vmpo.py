@@ -52,7 +52,9 @@ def top_half_mask(adv: jax.Array, k: int) -> jax.Array:
     value the mask keeps all of them (>k selected) while ``topk`` keeps an
     arbitrary k. Tied entries have identical ratios, so psi mass shifts
     only between equally-weighted terms; GAE advantages are continuous so
-    measure-zero in practice.
+    measure-zero in practice. The temperature dual normalizes by the
+    ACTUAL mask count (sum(mask), not a static k*T), so over-selection
+    under ties does not bias eta.
     """
     kth_largest = -jnp.sort(-adv, axis=0)[k - 1]  # (T, 1)
     return (adv >= kth_largest).astype(adv.dtype)  # (B, T, 1)
@@ -89,7 +91,12 @@ def make_train_step(cfg: Config, family: ModelFamily):
         # low while advantages spike). logsumexp(r) - log(N) is the same
         # quantity in exact arithmetic, stable for any ratio magnitude —
         # documented divergence, numerics only.
-        n_selected = float(k * advantage.shape[1] * advantage.shape[2])
+        # N must be the ACTUAL selected count: the tie-keeping mask can
+        # select more than k entries (see top_half_mask), and a static k*T
+        # would then misnormalize the dual toward a too-large eta. Counting
+        # the mask keeps the dual exact under ties; stop_gradient because N
+        # is a set size, not a function to differentiate through.
+        n_selected = jax.lax.stop_gradient(jnp.sum(mask))
         loss_temperature = eta * cfg.coef_eta + eta * (lse - jnp.log(n_selected))
 
         # per-update KL bound, log-uniform in [coef_alpha_below, coef_alpha_upper]

@@ -176,7 +176,30 @@ class Config:
     # RTT of a remote-execution tunnel, which at the reference quantum
     # (sub-ms updates) otherwise dominates measured learner throughput.
     # 1 = dispatch per batch (reference semantics).
+    # Two dispatch-granularity caveats: (a) the update counter advances K per
+    # dispatch, so between-dispatch checks — notably the entropy/lr anneal
+    # switch — can fire up to K-1 updates late; (b) a max_updates budget
+    # smaller than K clamps the chain down to the budget at learner start
+    # (a small budget performs real updates instead of silently zero).
     learner_chain: int = 1
+    # Learner host-data-plane pipelining: depth of the prefetch queue. The
+    # feed (shm sample/consume -> carry zeroing -> Batch assembly -> H2D
+    # placement with the step's sharding) runs on a background thread and
+    # the learner pops device-resident batches, so the NEXT dispatch's host
+    # work overlaps the CURRENT train_step (tpu_rl/data/prefetch.py). Costs
+    # depth x batch bytes of device memory and at most `depth` dispatches of
+    # extra on-policy staleness. 0 = synchronous feed (the A/B switch and
+    # the pre-pipeline serial semantics).
+    learner_prefetch: int = 2
+    # Off-policy update:data ratio cap: maximum learner updates per received
+    # environment transition (transitions = stored windows x seq_len). The
+    # replay learner WAITS (idles, heartbeating) while one more update would
+    # exceed the cap, instead of free-running against the ring (~50:1
+    # measured on a shared core, CLUSTER_R5_SAC.md — the round-5 blocker:
+    # re-fitting early random experience). E.g. 0.2 allows one update per 5
+    # transitions. None = no gate (reference parity: sample as fast as the
+    # ring answers). Ignored by on-policy algos (their store consumes).
+    max_update_data_ratio: float | None = None
     # Sequence-parallel mesh size (long-context training; needs
     # model="transformer" and attention_impl "ring"/"ulysses").
     mesh_seq: int = 1
@@ -323,6 +346,15 @@ class Config:
                 "zero-init is the V-trace/IMPALA fix (CLUSTER_R5_PPO.md)",
             )
         assert self.learner_chain >= 1, self.learner_chain
+        assert self.learner_prefetch >= 0, (
+            f"learner_prefetch must be >= 0 (0 = synchronous feed), "
+            f"got {self.learner_prefetch}"
+        )
+        if self.max_update_data_ratio is not None:
+            assert self.max_update_data_ratio > 0, (
+                f"max_update_data_ratio must be > 0 (updates per received "
+                f"transition), got {self.max_update_data_ratio}"
+            )
         if self.learner_chain > 1:
             # Chained dispatch rides make_parallel_train_step's scan; the
             # (data, seq) mesh step and the multihost global-array feed

@@ -7,6 +7,11 @@ the device boundary is crossed exactly once, in ``parallel.dp.shard_batch``.
 
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.assembler import RolloutAssembler, Trajectory
+from tpu_rl.data.prefetch import (
+    PrefetchPipeline,
+    SynchronousFeed,
+    UpdateRatioGate,
+)
 from tpu_rl.data.shm_ring import OnPolicyStore, ReplayStore, make_store
 
 __all__ = [
@@ -14,6 +19,9 @@ __all__ = [
     "RolloutAssembler",
     "Trajectory",
     "OnPolicyStore",
+    "PrefetchPipeline",
     "ReplayStore",
+    "SynchronousFeed",
+    "UpdateRatioGate",
     "make_store",
 ]

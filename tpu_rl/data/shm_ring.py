@@ -172,6 +172,18 @@ class ReplayStore(_StoreBase):
         with self.h.lock:
             return min(self.h.count.value, self.capacity)
 
+    @property
+    def total_puts(self) -> int:
+        """Trajectory windows EVER written (monotonic; the ring overwrites
+        but ``count`` never resets) — the data-arrival odometer behind the
+        off-policy update:data ratio gate."""
+        with self.h.lock:
+            return self.h.count.value
+
+    def transitions_received(self) -> int:
+        """Environment transitions ever received = windows x seq_len."""
+        return self.total_puts * self.layout.seq_len
+
     def sample(
         self, batch: int, rng: np.random.Generator, max_retries: int = 8
     ) -> dict[str, np.ndarray] | None:

@@ -201,7 +201,18 @@ def mixed_dot(a, b, dtype=jnp.bfloat16):
     (10.25 ms bf16 vs 10.16 f32; the backward holds ~2/3 of the matmul
     FLOPs). This VJP casts the cotangent to ``dtype`` too — standard
     mixed-precision practice; gradients pick up one bf16 rounding, while
-    accumulation (``preferred_element_type``) and all results stay f32."""
+    accumulation (``preferred_element_type``) and all results stay f32.
+
+    2-D operands only: the backward's ``.T``-transposed dots assume plain
+    matrices, and batched/1-D operands would silently compute the wrong
+    gradient contraction rather than fail. Reshape to 2-D at the call site
+    (every LSTM use is ``(rows, features) @ (features, cols)``)."""
+    if a.ndim != 2 or b.ndim != 2:
+        raise ValueError(
+            "mixed_dot requires 2-D operands (its custom VJP transposes "
+            f"with .T); got a.ndim={a.ndim}, b.ndim={b.ndim}. Reshape to "
+            "matrices before calling."
+        )
     return jnp.dot(
         a.astype(dtype), b.astype(dtype), preferred_element_type=jnp.float32
     )

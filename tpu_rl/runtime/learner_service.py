@@ -13,20 +13,37 @@ TPU-first redesign:
 - when ``cfg.mesh_data > 1`` the step is compiled with GSPMD shardings over
   the data mesh (``tpu_rl.parallel.dp``) — XLA inserts the ICI gradient
   all-reduce the reference has no equivalent of;
-- weight broadcast is ``jax.device_get`` of the actor tree only, throttled by
-  ``publish_interval`` instead of once per update, so host transfer never
-  stalls the device pipeline (SURVEY.md §7 hard-parts);
+- the host data plane is PIPELINED (``cfg.learner_prefetch``): a feeder
+  thread samples shm, assembles the batch, and eagerly places it on device
+  with the step's sharding, so the next dispatch's shm copy + H2D transfer
+  overlaps the current ``train_step`` (``tpu_rl/data/prefetch.py``; the
+  Podracer overlap, Hessel et al. 2104.06272). ``learner_prefetch=0``
+  restores the serial feed for A/B;
+- weight broadcast is an ASYNC host-copy snapshot of the actor tree only —
+  a device-side copy + ``copy_to_host_async``, with the blocking
+  ``device_get`` and the ZMQ send on a publisher thread — throttled by
+  ``publish_interval``, so host transfer never stalls the device pipeline
+  (SURVEY.md §7 hard-parts);
+- off-policy learners honor ``cfg.max_update_data_ratio`` (update:data
+  ratio gate — the replay learner waits for fresh transitions instead of
+  free-running against the ring, CLUSTER_R5_SAC.md);
 - checkpoints carry params + optimizer state + update counter (orbax).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
 
 from tpu_rl.config import Config, is_off_policy
 from tpu_rl.data.layout import BatchLayout
+from tpu_rl.data.prefetch import (
+    PrefetchPipeline,
+    SynchronousFeed,
+    UpdateRatioGate,
+)
 from tpu_rl.data.shm_ring import ShmHandles, make_store
 from tpu_rl.runtime.manager import STAT_WINDOW
 from tpu_rl.runtime.protocol import Protocol
@@ -41,6 +58,71 @@ def _crossed(prev: int, cur: int, interval: int) -> bool:
     dispatch the counter advances K per iteration and plain modulo would
     skip firings whose multiple falls inside the jump."""
     return cur // interval > prev // interval
+
+
+class AsyncPublisher:
+    """Weight broadcast off the learner's critical path.
+
+    ``publish(actor)`` runs only cheap async dispatches on the caller:
+    a device-side ``jnp.copy`` of the actor tree (independent buffers, so
+    the next ``train_step``'s donation of the state cannot invalidate the
+    snapshot mid-copy) and ``copy_to_host_async`` to start the D2H DMA.
+    The blocking ``jax.device_get`` — which must wait for the update that
+    produced the weights AND the transfer — plus codec + ZMQ send happen on
+    this thread, overlapped with the learner's next dispatches.
+
+    Latest-wins slot (not a queue): under backpressure workers want the
+    NEWEST weights, and per-snapshot order is irrelevant once superseded.
+    The ZMQ ``Pub`` is used from this thread only after construction
+    (sockets are single-threaded); ``close()`` flushes a pending snapshot
+    so the final weights of a run still reach the fleet, then joins.
+    A send failure re-raises out of the next ``publish()``.
+    """
+
+    def __init__(self, pub: Pub):
+        self._pub = pub
+        self._cond = threading.Condition()
+        self._pending = None
+        self._error: BaseException | None = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="learner-publish", daemon=True
+        )
+        self._thread.start()
+
+    def publish(self, actor) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if self._error is not None:
+            raise self._error
+        snap = jax.tree.map(jnp.copy, actor)  # donation-proof device copy
+        jax.tree.map(lambda x: x.copy_to_host_async(), snap)
+        with self._cond:
+            self._pending = snap  # latest wins
+            self._cond.notify()
+
+    def _run(self) -> None:
+        import jax
+
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait(timeout=0.1)
+                if self._pending is None:  # closed and flushed
+                    return
+                snap, self._pending = self._pending, None
+            try:
+                self._pub.send(Protocol.Model, {"actor": jax.device_get(snap)})
+            except BaseException as e:  # noqa: BLE001 — surfaces in publish()
+                self._error = e
+                return
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=timeout)
 
 
 class LearnerService:
@@ -65,6 +147,7 @@ class LearnerService:
         self.max_updates = max_updates
         self.publish_interval = publish_interval
         self.seed = seed
+        self._publisher: AsyncPublisher | None = None
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -113,7 +196,21 @@ class LearnerService:
         # same mesh/jit wrapping.
         self._place_global = None
         chain = max(1, cfg.learner_chain)
+        if self.max_updates is not None and chain > self.max_updates:
+            # A budget smaller than the chain would otherwise complete
+            # "successfully" with ZERO updates (the pre-dispatch budget
+            # check fires before the first dispatch). Clamp so a small
+            # budget performs real updates; callers wanting a hard error
+            # should validate their own run plans.
+            print(
+                f"[learner] learner_chain {chain} exceeds max_updates "
+                f"{self.max_updates}; clamping chain to "
+                f"{max(1, self.max_updates)}", flush=True,
+            )
+            chain = max(1, self.max_updates)
         self._chain_mesh = None
+        self._batch_sharding = None  # eager-placement target (prefetch feed)
+        self._device = jax.devices()[0]
         if mesh is not None:  # built above iff cfg.mesh_seq > 1
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -124,9 +221,8 @@ class LearnerService:
                 return make_sp_train_step(step, mesh, wcfg)
 
             state = replicate(state, mesh)
-            self._setup_multihost_feed(
-                NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
-            )
+            self._batch_sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+            self._setup_multihost_feed(self._batch_sharding)
         elif cfg.mesh_data > 1 or chain > 1:
             # chain > 1 rides the same GSPMD wrapper even on one device
             # (make_mesh(1)): the chained lax.scan program is what
@@ -142,6 +238,10 @@ class LearnerService:
                 return make_parallel_train_step(step, mesh, wcfg, chain=chain)
 
             state = replicate(state, mesh)
+            if chain == 1:
+                # chain > 1 places via shard_chained_batch in _assemble;
+                # chain == 1 places eagerly against the DP batch sharding.
+                self._batch_sharding = batch_sharding(mesh)
             self._setup_multihost_feed(batch_sharding(mesh))
         else:
 
@@ -172,6 +272,11 @@ class LearnerService:
                 )
 
         pub = Pub("*", self.model_port, bind=True, hwm=MODEL_HWM)
+        # Async broadcast rides the same switch as the feed pipeline so
+        # learner_prefetch=0 is a FULLY serial A/B baseline.
+        self._publisher = (
+            AsyncPublisher(pub) if cfg.learner_prefetch > 0 else None
+        )
         writer = make_writer(cfg.result_dir)
         logger = LearnerLogger(writer, cfg.algo)
         # One timed window per DISPATCH; a chained dispatch carries
@@ -198,10 +303,12 @@ class LearnerService:
                 f"of learner_chain {chain}; budget rounds DOWN to "
                 f"{self.max_updates // chain * chain} updates", flush=True,
             )
+        # The feed: a background prefetch pipeline (default) or the inline
+        # synchronous path (learner_prefetch=0). Either way the loop below
+        # pops ONE device-ready dispatch batch per iteration.
+        feed = self._make_feed(store, rng, chain)
         idx = start_idx
         profiling = False
-        pending: list[dict] = []
-        batching_secs = 0.0
         try:
             while not self._stopped():
                 # A dispatch always advances the counter by `chain`, so stop
@@ -212,37 +319,40 @@ class LearnerService:
                     and idx - start_idx + chain > self.max_updates
                 ):
                     break
-                # Idle polls stay OUTSIDE the throughput timer: an empty-store
-                # iteration processes zero transitions and must not inflate
-                # the learner-FPS window. Per-consume spans are summed into
-                # batching_secs so a chained dispatch reports ALL K shm
-                # copies, not just the last one.
-                t_sample = time.perf_counter()
-                raw = self._next_batch(store, rng)
-                if raw is None:
+                # Idle polls (store starving, or the update-ratio gate
+                # holding) stay OUTSIDE the throughput timer: they process
+                # zero transitions and must not deflate the learner-FPS
+                # window. A successful pop's bounded wait IS counted — with
+                # prefetch it is the pipeline's residual feed latency, the
+                # honest critical-path cost of a dispatch.
+                t_wait = time.perf_counter()
+                item = feed.get(timeout=0.05)
+                if item is None:
                     if self.heartbeat is not None:
                         self.heartbeat.value = time.time()
-                    time.sleep(0.002)
+                    if feed.poll_sleep:
+                        time.sleep(feed.poll_sleep)
                     continue
-                batching_secs += time.perf_counter() - t_sample
-                pending.append(raw)
-                if len(pending) < chain:
-                    # keep consuming toward a full chained dispatch
-                    # (stores copy on read, so held batches are stable);
-                    # heartbeat so a slowly-filling chain can't look dead
-                    if self.heartbeat is not None:
-                        self.heartbeat.value = time.time()
-                    continue
-                with timer.timer("learner-throughput", check_throughput=True):
-                    t_assemble = time.perf_counter()
-                    batch = self._assemble(pending)
-                    pending = []
-                    batching_secs += time.perf_counter() - t_assemble
-                    timer.record("learner-batching-time", batching_secs)
-                    batching_secs = 0.0
-                    with timer.timer("learner-step-time"):
-                        key, sub_key = jax.random.split(key)
-                        state, metrics = train_step(state, batch, sub_key)
+                wait_secs = time.perf_counter() - t_wait
+                batch, feed_secs = item
+                t_step = time.perf_counter()
+                key, sub_key = jax.random.split(key)
+                state, metrics = train_step(state, batch, sub_key)
+                step_secs = time.perf_counter() - t_step
+                # learner-batching-time is the feed-side host work (shm
+                # copies + assembly + H2D placement). With prefetch it
+                # overlaps the device step, so the per-dispatch critical
+                # path — the throughput window — is queue-wait + step;
+                # overlap shows as queue-wait << batching-time.
+                timer.record("learner-batching-time", feed_secs)
+                timer.record("learner-queue-wait-time", wait_secs)
+                timer.record("learner-step-time", step_secs)
+                timer.record_gauge("learner-queue-depth", feed.qsize())
+                timer.record(
+                    "learner-throughput",
+                    wait_secs + step_secs,
+                    check_throughput=True,
+                )
                 prev_idx, idx = idx, idx + chain
 
                 progress = idx if anneal_absolute else idx - start_idx
@@ -307,6 +417,12 @@ class LearnerService:
                     )
                     break
         finally:
+            # Feeder first (stops shm sampling), then the publisher (joins
+            # its thread, flushing the final snapshot — the Pub socket is
+            # only safe to close once no other thread can touch it).
+            feed.close()
+            if self._publisher is not None:
+                self._publisher.close()
             if profiling:
                 # Never leave a trace open (early exit / stop-event / crash).
                 jax.profiler.stop_trace()
@@ -335,6 +451,63 @@ class LearnerService:
             return store.sample(self.cfg.batch_size, rng)
         return store.consume()
 
+    def _make_fetch(self, store, rng):
+        """Raw-batch producer for the feed, with the off-policy update:data
+        ratio gate folded in. The gate counts batches at FETCH time (not at
+        update completion) so the prefetch pipeline cannot overdraw the data
+        budget by pre-pulling samples the learner has not yet earned."""
+        gate = None
+        if (
+            is_off_policy(self.cfg.algo)
+            and self.cfg.max_update_data_ratio is not None
+        ):
+            gate = UpdateRatioGate(self.cfg.max_update_data_ratio)
+        self._feed_gate = gate  # introspection hook for tests
+
+        def fetch():
+            if gate is not None and not gate.ready(
+                store.transitions_received()
+            ):
+                return None
+            raw = self._next_batch(store, rng)
+            if raw is not None and gate is not None:
+                gate.note_fetched()
+            return raw
+
+        return fetch
+
+    def _make_feed(self, store, rng, chain: int):
+        """The learner's data plane: prefetch pipeline (feeder thread,
+        device-ready double buffering) or the inline synchronous equivalent.
+        Both produce identical batches in identical order — the sampler RNG
+        and the chain accumulation live in the shared fetch/assemble
+        closures — so the A/B switch changes timing only."""
+        fetch = self._make_fetch(store, rng)
+        if self.cfg.learner_prefetch > 0:
+            return PrefetchPipeline(
+                fetch,
+                self._assemble_device,
+                chain=chain,
+                depth=self.cfg.learner_prefetch,
+                stop_event=self.stop_event,
+            )
+        return SynchronousFeed(fetch, self._assemble_device, chain=chain)
+
+    def _assemble_device(self, raws: list):
+        """Assemble + eager device placement with the step's input sharding,
+        so the H2D transfer happens feed-side (overlapped under prefetch)
+        instead of inside the jitted call's implicit transfer."""
+        import jax
+
+        batch = self._assemble(raws)
+        if self._place_global is not None or self._chain_mesh is not None:
+            # Already placed during assembly: host_local_batch_to_global /
+            # shard_chained_batch both produce global device arrays.
+            return batch
+        if self._batch_sharding is not None:
+            return jax.device_put(batch, self._batch_sharding)
+        return jax.device_put(batch, self._device)
+
     def _setup_multihost_feed(self, sharding) -> None:
         """On a multi-host mesh, each learner host feeds its OWN rows of the
         global batch (its storage process only sees local workers); batches
@@ -359,14 +532,19 @@ class LearnerService:
     # ------------------------------------------------------------ broadcast
     def _publish(self, pub: Pub, state) -> None:
         """Ship the actor tree as host numpy (SAC broadcasts the actor only,
-        reference ``sac/learning.py:145``)."""
-        import jax
-
+        reference ``sac/learning.py:145``). With the async publisher the
+        caller only snapshots + starts the D2H; the blocking device_get and
+        ZMQ send run on the publisher thread."""
         actor = (
             state.actor_params
             if hasattr(state, "actor_params")
             else state.params["actor"]
         )
+        if self._publisher is not None:
+            self._publisher.publish(actor)
+            return
+        import jax
+
         pub.send(Protocol.Model, {"actor": jax.device_get(actor)})
 
     def _log_fleet_stat(self, logger: LearnerLogger) -> None:

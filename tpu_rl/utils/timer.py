@@ -22,6 +22,7 @@ class ExecutionTimer:
         self.throughput: dict[str, deque] = defaultdict(
             lambda: deque(maxlen=window)
         )
+        self.gauges: dict[str, deque] = defaultdict(lambda: deque(maxlen=window))
 
     @contextlib.contextmanager
     def timer(self, name: str, check_throughput: bool = False):
@@ -29,15 +30,21 @@ class ExecutionTimer:
         try:
             yield self
         finally:
-            dt = time.perf_counter() - t0
-            self.elapsed[name].append(dt)
-            if check_throughput and self.num_transition and dt > 0:
-                self.throughput[name].append(self.num_transition / dt)
+            self.record(name, time.perf_counter() - t0, check_throughput)
 
-    def record(self, name: str, dt: float) -> None:
+    def record(self, name: str, dt: float, check_throughput: bool = False) -> None:
         """Record an externally-measured duration (for spans whose success is
-        only known after the fact, e.g. a store poll that found data)."""
+        only known after the fact — a store poll that found data — or spans
+        stitched from pieces, e.g. queue-wait + step in the pipelined
+        learner loop)."""
         self.elapsed[name].append(dt)
+        if check_throughput and self.num_transition and dt > 0:
+            self.throughput[name].append(self.num_transition / dt)
+
+    def record_gauge(self, name: str, value: float) -> None:
+        """Record a unitless instantaneous value (e.g. the prefetch queue
+        depth at pop time) into the same bounded window machinery."""
+        self.gauges[name].append(float(value))
 
     def mean_elapsed(self, name: str) -> float | None:
         q = self.elapsed.get(name)
@@ -47,9 +54,14 @@ class ExecutionTimer:
         q = self.throughput.get(name)
         return sum(q) / len(q) if q else None
 
+    def mean_gauge(self, name: str) -> float | None:
+        q = self.gauges.get(name)
+        return sum(q) / len(q) if q else None
+
     def scalars(self) -> dict[str, float]:
         """All windows reduced to means, keyed with the reference's
-        tensorboard naming."""
+        tensorboard naming (gauges get a plain ``-mean`` suffix: they are
+        not durations)."""
         out = {}
         for name in self.elapsed:
             m = self.mean_elapsed(name)
@@ -59,4 +71,8 @@ class ExecutionTimer:
             m = self.mean_throughput(name)
             if m is not None:
                 out[f"{name}-transition-per-secs"] = m
+        for name in self.gauges:
+            m = self.mean_gauge(name)
+            if m is not None:
+                out[f"{name}-mean"] = m
         return out
