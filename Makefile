@@ -1,0 +1,18 @@
+# Developer/CI entry points. `make ci` is what the workflow runs.
+
+PY ?= python
+
+.PHONY: lint format-check test ci
+
+lint:
+	ruff check .
+
+format-check:
+	ruff format --check .
+
+# Tier-1 suite: the fast CPU gate (slow-marked cluster/e2e tests excluded).
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+ci: lint test

@@ -575,6 +575,163 @@ def run_e2e_compare(
     return result
 
 
+# ------------------------------------------------------------ acting A/B
+def _bench_local_acting(cfg, family, params, n_envs: int, acts: int) -> float:
+    """Acts/sec of one worker's local path: batched jitted forward + the
+    host readback every tick pays (the worker materializes numpy actions to
+    step envs). Env stepping itself is excluded on BOTH sides — this A/B
+    isolates the acting path, ``examples/bench_worker_throughput.py`` owns
+    the full loop."""
+    act = jax.jit(family.act)
+    rng = np.random.default_rng(0)
+    obs = rng.standard_normal((n_envs, int(cfg.obs_shape[0]))).astype(
+        np.float32
+    )
+    hw, cw = family.carry_widths
+    h = jnp.zeros((n_envs, hw))
+    c = jnp.zeros((n_envs, cw))
+    key = jax.random.key(0)
+    key, sub = jax.random.split(key)
+    a, _logits, _lp, h, c = act(params, jnp.asarray(obs), h, c, sub)  # compile
+    np.asarray(a)
+    t0 = time.perf_counter()
+    for _ in range(acts):
+        key, sub = jax.random.split(key)
+        a, logits, lp, h, c = act(params, jnp.asarray(obs), h, c, sub)
+        np.asarray(a), np.asarray(logits), np.asarray(lp)
+    dt = time.perf_counter() - t0
+    return acts * n_envs / dt
+
+
+def run_act_compare(
+    clients: int | None = None,
+    envs_per_client: int | None = None,
+    acts: int | None = None,
+    port: int = 29920,
+    out_path: str | None = None,
+) -> dict:
+    """Local vs remote (SEED-style centralized) acting throughput, one
+    process: N client threads with real ``InferenceClient`` DEALER sockets
+    drive the production ``InferenceService`` ROUTER + padded-batch jitted
+    act, against the same model acting locally. Reports the new
+    ``inference-batch-size`` / ``inference-rtt`` / ``inference-step-time``
+    timers alongside acts/sec on both sides.
+
+    On one host the remote path pays the loopback RTT + codec per tick and
+    usually loses; the number that matters for the SEED thesis is the
+    server-side step time vs batch size (device amortization) and the RTT
+    breakdown this emits — on a TPU deployment the same wire cost buys
+    accelerator-grade acting for the whole fleet."""
+    import threading
+
+    from tpu_rl.config import Config
+    from tpu_rl.models.families import build_family
+    from tpu_rl.runtime.inference_service import (
+        InferenceClient,
+        InferenceService,
+    )
+    from tpu_rl.utils.timer import ExecutionTimer
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if clients is None:
+        clients = 4
+    if envs_per_client is None:
+        envs_per_client = 16
+    if acts is None:
+        acts = 150 if on_cpu else 600
+    if out_path is None:
+        out_path = "bench_act.cpu.json" if on_cpu else "bench_act.json"
+
+    cfg = Config.from_dict(
+        dict(
+            algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=64,
+            worker_num_envs=envs_per_client, act_mode="remote",
+            inference_batch=clients * envs_per_client,
+            inference_flush_us=500, inference_timeout_ms=30_000,
+        )
+    )
+    family = build_family(cfg)
+    params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+
+    local_aps = _bench_local_acting(
+        cfg, family, params, envs_per_client, acts
+    )
+
+    svc = InferenceService(cfg, family, params, port=port, seed=0).start()
+    try:
+        assert svc.wait_ready(300.0) and svc.error is None, svc.error
+        rtt_timer = ExecutionTimer(window=10_000)  # shared; deques are safe
+        barrier = threading.Barrier(clients + 1)
+        failures = [0] * clients
+
+        def drive(k: int) -> None:
+            cl = InferenceClient(
+                cfg, "127.0.0.1", port, wid=k, timer=rtt_timer
+            )
+            try:
+                rng = np.random.default_rng(k)
+                obs = rng.standard_normal(
+                    (envs_per_client, int(cfg.obs_shape[0]))
+                ).astype(np.float32)
+                first = np.ones(envs_per_client, np.float32)
+                cl.act(obs, first)  # join + prime outside the timed region
+                barrier.wait()
+                first = np.zeros(envs_per_client, np.float32)
+                for _ in range(acts):
+                    if cl.act(obs, first) is None:
+                        failures[k] += 1
+            finally:
+                cl.close()
+
+        threads = [
+            threading.Thread(target=drive, args=(k,), daemon=True)
+            for k in range(clients)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        remote_aps = clients * acts * envs_per_client / dt
+
+        tmr = svc.timer
+        ms = lambda t, name: (  # noqa: E731 — row-local shorthand
+            round(t.mean_elapsed(name) * 1e3, 3)
+            if t.mean_elapsed(name) is not None else None
+        )
+        batch_mean = tmr.mean_gauge("inference-batch-size")
+        result = {
+            "metric": "batched acting throughput, local vs remote",
+            "device_kind": jax.devices()[0].device_kind,
+            "clients": clients,
+            "envs_per_client": envs_per_client,
+            "acts_per_client": acts,
+            "local_acts_per_s": round(local_aps, 1),
+            "remote_acts_per_s": round(remote_aps, 1),
+            "remote_vs_local": round(remote_aps / local_aps, 3),
+            "inference_rtt_ms": ms(rtt_timer, "inference-rtt"),
+            "inference_step_ms": ms(tmr, "inference-step-time"),
+            "inference_batch_mean": (
+                round(batch_mean, 1) if batch_mean is not None else None
+            ),
+            "inference_batch_max": cfg.inference_batch,
+            "flushes_full": svc.n_flush_full,
+            "flushes_deadline": svc.n_flush_deadline,
+            "client_failures": sum(failures),
+            "recorded_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+    finally:
+        svc.close()
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result), file=sys.stderr, flush=True)
+    return result
+
+
 def _accelerator_reachable(timeout_s: float = 120.0) -> str | None:
     from tpu_rl.utils.platform import accelerator_reachable
 
@@ -635,6 +792,13 @@ def last_good_onchip(path: str | None = None) -> dict | None:
 
 
 if __name__ == "__main__":
+    if os.environ.get("TPU_RL_BENCH_ACT"):
+        # Acting A/B mode: local jitted acting vs the centralized inference
+        # service (SEED-style remote acting) with real DEALER/ROUTER
+        # round-trips, on whatever backend jax resolved. See also
+        # examples/bench_remote_acting.py for the parameterized CLI.
+        print(json.dumps(run_act_compare()))
+        sys.exit(0)
     if os.environ.get("TPU_RL_BENCH_E2E"):
         # e2e feed A/B mode: sync vs prefetched LearnerService through the
         # real shm path, on whatever backend jax resolved (set

@@ -1,0 +1,55 @@
+"""Local vs remote (SEED-style centralized) acting throughput.
+
+The harness lives in ``bench.run_act_compare`` (shared with the
+``TPU_RL_BENCH_ACT=1 python bench.py`` mode); this wrapper adds the CLI. It
+drives the production ``InferenceService`` (learner-device padded-batch
+jitted act behind a ZMQ ROUTER) with N real ``InferenceClient`` DEALER
+threads, against the same model acting locally, and reports acts/sec plus
+the ``inference-rtt`` / ``inference-batch-size`` / ``inference-step-time``
+timer breakdown.
+
+Run (CPU host or TPU host — the service compiles for whatever backend jax
+resolves):
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/bench_remote_acting.py \
+      [--clients 4] [--envs 16] [--acts 150] [--port 29920] \
+      [--out bench_act.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--clients", type=int, default=None,
+                   help="concurrent worker clients (default 4)")
+    p.add_argument("--envs", type=int, default=None,
+                   help="envs (= obs rows) per client per tick (default 16)")
+    p.add_argument("--acts", type=int, default=None,
+                   help="timed acting ticks per client "
+                        "(default 150 on CPU, 600 on an accelerator)")
+    p.add_argument("--port", type=int, default=29920)
+    p.add_argument("--out", default=None,
+                   help="result JSON path (default bench_act[.cpu].json)")
+    args = p.parse_args()
+
+    from bench import run_act_compare
+
+    result = run_act_compare(
+        clients=args.clients,
+        envs_per_client=args.envs,
+        acts=args.acts,
+        port=args.port,
+        out_path=args.out,
+    )
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()

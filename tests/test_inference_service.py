@@ -1,0 +1,468 @@
+"""Centralized-inference subsystem tests (ISSUE 2): ROUTER/DEALER transport,
+the InferenceService's dynamic batching (deadline + full flush), server-side
+recurrent carry, rejected-frame tolerance, clean shutdown, the worker's
+remote-acting path with its local fallback, and the stat plumbing that
+surfaces ``n_model_loads`` / ``n_rejected`` (the cluster e2e remote run lives
+in ``test_runtime.py::test_remote_acting_cluster_end_to_end``)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+import zmq
+
+from tests.conftest import small_config
+from tpu_rl.models.families import build_family
+from tpu_rl.runtime.inference_service import InferenceClient, InferenceService
+from tpu_rl.runtime.manager import Manager, STAT_WINDOW
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.storage import LearnerStorage, STAT_SLOTS
+from tpu_rl.runtime.transport import Dealer, Router
+
+BASE = 30150  # this module's port range; test_runtime owns 29xxx
+
+
+def _svc_config(**kw):
+    base = dict(
+        env="CartPole-v1",
+        algo="PPO",
+        act_mode="remote",
+        worker_num_envs=2,
+        inference_batch=8,
+        inference_flush_us=2000,
+        inference_timeout_ms=5000,
+        inference_retries=1,
+        worker_step_sleep=0.0,
+    )
+    base.update(kw)
+    return small_config(**base)
+
+
+def _start_service(port: int, **cfg_kw):
+    cfg = _svc_config(**cfg_kw)
+    family = build_family(cfg)
+    params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+    svc = InferenceService(cfg, family, params, port=port).start()
+    assert svc.wait_ready(120.0), svc.error
+    assert svc.error is None, svc.error
+    return cfg, family, params, svc
+
+
+def _obs(n, cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, int(cfg.obs_shape[0]))).astype(np.float32)
+
+
+# ------------------------------------------------------------- transport
+class TestRouterDealer:
+    def test_roundtrip(self):
+        port = BASE
+        router = Router("127.0.0.1", port, bind=True)
+        dealer = Dealer("127.0.0.1", port, identity=b"client-a")
+        try:
+            payload = {"seq": 7, "obs": np.ones((2, 4), np.float32)}
+            dealer.send(Protocol.ObsRequest, payload)
+            got = router.recv(timeout_ms=5000)
+            assert got is not None
+            identity, proto, decoded = got
+            assert identity == b"client-a"
+            assert proto == Protocol.ObsRequest
+            assert decoded["seq"] == 7
+            np.testing.assert_array_equal(decoded["obs"], payload["obs"])
+
+            router.send(identity, Protocol.Act, {"seq": 7, "act": [1.0]})
+            reply = dealer.recv(timeout_ms=5000)
+            assert reply is not None
+            rproto, rpayload = reply
+            assert rproto == Protocol.Act and rpayload["seq"] == 7
+        finally:
+            dealer.close()
+            router.close()
+
+    def test_replies_route_per_identity(self):
+        port = BASE + 1
+        router = Router("127.0.0.1", port, bind=True)
+        a = Dealer("127.0.0.1", port, identity=b"a")
+        b = Dealer("127.0.0.1", port, identity=b"b")
+        try:
+            a.send(Protocol.ObsRequest, {"seq": 1})
+            b.send(Protocol.ObsRequest, {"seq": 2})
+            seen = {}
+            for _ in range(2):
+                identity, _proto, payload = router.recv(timeout_ms=5000)
+                seen[identity] = payload["seq"]
+            assert seen == {b"a": 1, b"b": 2}
+            # replies cross: each dealer gets exactly its own
+            router.send(b"b", Protocol.Act, {"seq": 2})
+            router.send(b"a", Protocol.Act, {"seq": 1})
+            assert a.recv(timeout_ms=5000)[1]["seq"] == 1
+            assert b.recv(timeout_ms=5000)[1]["seq"] == 2
+        finally:
+            a.close()
+            b.close()
+            router.close()
+
+    def test_malformed_frame_counted_not_raised(self):
+        port = BASE + 2
+        router = Router("127.0.0.1", port, bind=True)
+        ctx = zmq.Context.instance()
+        raw = ctx.socket(zmq.DEALER)
+        raw.connect(f"tcp://127.0.0.1:{port}")
+        good = Dealer("127.0.0.1", port, identity=b"good")
+        try:
+            raw.send_multipart([b"\x00garbage", b"not-a-frame"])
+            assert router.recv(timeout_ms=5000) is None  # dropped, counted
+            assert router.n_rejected == 1
+            # the fabric survives: a well-formed client still gets through
+            good.send(Protocol.ObsRequest, {"seq": 3})
+            got = router.recv(timeout_ms=5000)
+            assert got is not None and got[2]["seq"] == 3
+        finally:
+            raw.close(linger=0)
+            good.close()
+            router.close()
+
+
+# -------------------------------------------------------------- service
+class TestInferenceService:
+    def test_deadline_flush_partial_batch(self):
+        port = BASE + 10
+        cfg, family, _params, svc = _start_service(
+            port, inference_batch=64, inference_flush_us=1500
+        )
+        client = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+        try:
+            obs = _obs(2, cfg)
+            first = np.ones(2, np.float32)
+            reply = client.act(obs, first)
+            # 2 rows can never fill a 64-slot batch: only the deadline can
+            # have flushed, inside the client's timeout.
+            assert reply is not None and reply["seq"] == 0
+            assert reply["act"].shape == (2, 1)
+            assert reply["logits"].shape == (2, int(cfg.action_space))
+            assert reply["log_prob"].shape == (2, 1)
+            assert svc.n_flush_deadline >= 1 and svc.n_flush_full == 0
+        finally:
+            client.close()
+            svc.close()
+
+    def test_full_batch_flushes_before_deadline(self):
+        port = BASE + 11
+        # batch == rows-per-request, deadline far away: the full-batch
+        # trigger must fire well before the 2 s flush window.
+        cfg, _family, _params, svc = _start_service(
+            port, inference_batch=2, inference_flush_us=2_000_000
+        )
+        client = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+        try:
+            t0 = time.perf_counter()
+            reply = client.act(_obs(2, cfg), np.ones(2, np.float32))
+            dt = time.perf_counter() - t0
+            assert reply is not None
+            assert dt < 1.5, f"full batch waited on the deadline ({dt:.2f}s)"
+            assert svc.n_flush_full >= 1
+        finally:
+            client.close()
+            svc.close()
+
+    def test_carry_lives_server_side(self):
+        """LSTM pre-step carry semantics across the wire: the first tick of
+        an episode acts from (and reports) a ZERO carry; the next tick's
+        reported pre-step carry equals the post-step carry a local worker
+        would have computed — without the client ever shipping h/c."""
+        port = BASE + 12
+        cfg, family, params, svc = _start_service(port)
+        assert family.store_carry
+        client = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+        try:
+            obs1, obs2 = _obs(2, cfg, seed=1), _obs(2, cfg, seed=2)
+            r1 = client.act(obs1, np.ones(2, np.float32))
+            assert r1 is not None
+            np.testing.assert_array_equal(r1["hx"], 0.0)
+            np.testing.assert_array_equal(r1["cx"], 0.0)
+
+            # The post-step carry is a deterministic function of (params,
+            # obs, pre-step carry) — sampling only affects action/log_prob —
+            # so the local replay pins what the server must hold.
+            import jax.numpy as jnp
+
+            hw, cw = family.carry_widths
+            _a, _lg, _lp, h2, c2 = family.act(
+                params, jnp.asarray(obs1), jnp.zeros((2, hw)),
+                jnp.zeros((2, cw)), jax.random.key(9),
+            )
+            r2 = client.act(obs2, np.zeros(2, np.float32))
+            assert r2 is not None
+            np.testing.assert_allclose(
+                r2["hx"], np.asarray(h2), rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                r2["cx"], np.asarray(c2), rtol=1e-5, atol=1e-6
+            )
+        finally:
+            client.close()
+            svc.close()
+
+    def test_param_swap_changes_policy(self):
+        port = BASE + 13
+        cfg, family, _params, svc = _start_service(port)
+        client = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+        try:
+            obs = _obs(2, cfg, seed=3)
+            first = np.ones(2, np.float32)  # zero carry -> logits are
+            # a deterministic function of params and obs alone
+            before = client.act(obs, first)["logits"]
+            again = client.act(obs, first)["logits"]
+            np.testing.assert_allclose(again, before, rtol=1e-6)
+
+            svc.set_params(
+                family.init_params(jax.random.key(123), seq_len=cfg.seq_len)
+            )
+            after = client.act(obs, first)["logits"]
+            assert not np.allclose(after, before), (
+                "set_params did not change the served policy"
+            )
+        finally:
+            client.close()
+            svc.close()
+
+    def test_rejected_request_does_not_kill_service(self):
+        port = BASE + 14
+        cfg, _family, _params, svc = _start_service(port)
+        bad = Dealer("127.0.0.1", port, identity=b"bad")
+        client = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+        try:
+            # Decodable frame, wrong schema: dropped and counted, never
+            # fatal — then a well-formed client is still served.
+            bad.send(Protocol.ObsRequest, {"seq": 0})  # no obs/first
+            bad.send(Protocol.Stat, 1.0)  # wrong protocol entirely
+            reply = client.act(_obs(2, cfg), np.ones(2, np.float32))
+            assert reply is not None
+            assert svc.running and svc.error is None
+            deadline = time.time() + 5
+            while svc.n_rejected_payload < 2 and time.time() < deadline:
+                time.sleep(0.05)
+            assert svc.n_rejected_payload == 2
+        finally:
+            bad.close()
+            client.close()
+            svc.close()
+
+    def test_clean_shutdown_releases_port(self):
+        port = BASE + 15
+        _cfg, _family, _params, svc = _start_service(port)
+        assert svc.running
+        svc.close()
+        assert not svc.running and svc.error is None
+        # the socket is really gone: the port can be rebound immediately
+        router = Router("127.0.0.1", port, bind=True)
+        router.close()
+
+
+# ------------------------------------------------------ worker remote path
+def _run_worker_capture(cfg, port_base, inference_port, n_frames=3,
+                        timeout=120.0):
+    """Run a Worker in a thread against a bound relay SUB; return
+    (worker, rollout_frames, stat_frames)."""
+    from tpu_rl.runtime.transport import Sub
+    from tpu_rl.runtime.worker import Worker
+
+    relay = Sub("127.0.0.1", port_base, bind=True)
+    stop = threading.Event()
+    w = Worker(
+        cfg, worker_id=0, manager_ip="127.0.0.1", manager_port=port_base,
+        learner_ip="127.0.0.1", model_port=port_base + 1, stop_event=stop,
+        inference_port=inference_port,
+    )
+    t = threading.Thread(target=w.run, daemon=True)
+    t.start()
+    rollouts, stats = [], []
+    deadline = time.time() + timeout
+    try:
+        while time.time() < deadline and len(rollouts) < n_frames:
+            msg = relay.recv(timeout_ms=200)
+            if msg is None:
+                continue
+            proto, payload = msg
+            if proto == Protocol.RolloutBatch:
+                rollouts.append(payload)
+            elif proto == Protocol.Stat:
+                stats.append(payload)
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        relay.close()
+    return w, rollouts, stats
+
+
+ROLLOUT_KEYS = (
+    "obs", "act", "rew", "logits", "log_prob", "is_fir", "hx", "cx", "id",
+    "done",
+)
+
+
+def _layout_of(frame):
+    return {
+        k: (np.asarray(frame[k]).shape, np.asarray(frame[k]).dtype)
+        for k in ROLLOUT_KEYS
+        if k != "id"
+    }
+
+
+@pytest.mark.timeout(240)
+def test_worker_remote_layout_matches_local():
+    """Acceptance: remote acting publishes RolloutBatch frames bit-identical
+    in LAYOUT (keys, shapes, dtypes) to local acting — manager, storage and
+    the algorithms cannot tell the modes apart."""
+    port = BASE + 20
+    cfg, _family, _params, svc = _start_service(
+        port, inference_batch=2, time_horizon=16
+    )
+    try:
+        w_remote, remote_frames, _ = _run_worker_capture(
+            cfg, BASE + 21, inference_port=port
+        )
+        assert remote_frames, "remote worker produced no rollouts"
+        assert not w_remote.fell_back, "remote worker silently fell back"
+        assert w_remote.n_remote_acts > 0
+    finally:
+        svc.close()
+
+    local_cfg = small_config(
+        env="CartPole-v1", algo="PPO", act_mode="local",
+        worker_num_envs=2, worker_step_sleep=0.0, time_horizon=16,
+    )
+    _w, local_frames, _ = _run_worker_capture(
+        local_cfg, BASE + 24, inference_port=None
+    )
+    assert local_frames, "local worker produced no rollouts"
+
+    rf, lf = remote_frames[0], local_frames[0]
+    assert set(rf.keys()) == set(lf.keys()) == set(ROLLOUT_KEYS)
+    assert _layout_of(rf) == _layout_of(lf)
+
+
+@pytest.mark.timeout(240)
+def test_worker_remote_falls_back_to_local_on_dead_server():
+    """Satellite: a worker whose requests time out retries, then PERMANENTLY
+    falls back to local acting — rollouts keep flowing, nothing wedges."""
+    cfg = _svc_config(
+        inference_timeout_ms=100, inference_retries=1, time_horizon=16
+    )
+    # nothing listens on the inference port
+    w, rollouts, stats = _run_worker_capture(
+        cfg, BASE + 27, inference_port=BASE + 29
+    )
+    assert w.fell_back, "worker never fell back from the dead server"
+    assert w.n_remote_acts == 0
+    assert rollouts, "fallback worker stopped producing rollouts"
+    # satellite: the episode Stat payload surfaces the health counters
+    if stats:
+        assert {"rew", "n_model_loads", "n_rejected", "wid"} <= set(
+            stats[0]
+        )
+
+
+@pytest.mark.timeout(240)
+def test_worker_stat_carries_model_loads():
+    """Satellite: n_model_loads is no longer a write-only counter — it rides
+    every episode Stat. With a live model publisher the count becomes
+    positive; without one it reports an honest zero."""
+    from tpu_rl.runtime.transport import MODEL_HWM, Pub
+
+    cfg = small_config(
+        env="CartPole-v1", algo="PPO", worker_num_envs=2,
+        worker_step_sleep=0.0, time_horizon=8,
+    )
+    port_base = BASE + 30
+    family = build_family(cfg)
+    params = family.init_params(jax.random.key(1), seq_len=cfg.seq_len)
+    model_pub = Pub("127.0.0.1", port_base + 1, bind=True, hwm=MODEL_HWM)
+    publish_stop = threading.Event()
+
+    def keep_publishing():
+        import jax as _jax
+
+        host = _jax.device_get(params["actor"])
+        while not publish_stop.is_set():
+            model_pub.send(Protocol.Model, {"actor": host})
+            time.sleep(0.05)
+
+    pub_thread = threading.Thread(target=keep_publishing, daemon=True)
+    pub_thread.start()
+    try:
+        _w, _rollouts, stats = _run_worker_capture(
+            cfg, port_base, inference_port=None, n_frames=30
+        )
+    finally:
+        publish_stop.set()
+        pub_thread.join(timeout=10)
+        model_pub.close()
+    assert stats, "no episode stats captured"
+    assert all(isinstance(s, dict) for s in stats)
+    assert any(s["n_model_loads"] > 0 for s in stats), (
+        "worker drained a live model publisher but reported zero loads"
+    )
+
+
+# ------------------------------------------------------------ stat plumbing
+class FakePub:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, proto, payload):
+        self.sent.append((proto, payload))
+
+
+class TestStatPlumbing:
+    def test_manager_windows_dict_stats_and_relays_health(self):
+        m = Manager(small_config(), 0, "127.0.0.1", 0)
+        pub = FakePub()
+        for i in range(STAT_WINDOW):
+            m._ingest(
+                Protocol.Stat,
+                {
+                    "rew": float(i),
+                    "n_model_loads": 5,
+                    "n_rejected": 2,
+                    "wid": i % 2,
+                },
+                pub,
+            )
+        assert len(pub.sent) == 1
+        _proto, payload = pub.sent[0]
+        assert payload["mean"] == np.mean(np.arange(float(STAT_WINDOW)))
+        assert payload["n"] == STAT_WINDOW
+        # cumulative counters are last-seen per wid, summed: 2 workers
+        assert payload["model_loads"] == 10
+        assert payload["rejected"] == 4  # no Sub bound -> workers only
+
+    def test_manager_still_accepts_bare_float_stats(self):
+        m = Manager(small_config(), 0, "127.0.0.1", 0)
+        pub = FakePub()
+        for i in range(STAT_WINDOW):
+            m._ingest(Protocol.Stat, float(i), pub)
+        assert len(pub.sent) == 1
+        assert pub.sent[0][1]["model_loads"] == 0
+
+    def test_storage_mailbox_health_slots(self):
+        assert STAT_SLOTS == 5
+        cfg = small_config()
+        sa = np.zeros(STAT_SLOTS, np.float32)
+        storage = LearnerStorage(cfg, handles=None, learner_port=0,
+                                 stat_array=sa)
+        storage._relay_stat(
+            {"mean": 7.5, "n": 50, "rejected": 3, "model_loads": 12}
+        )
+        assert sa[0] == 50 and sa[1] == 7.5 and sa[2] == 1.0
+        assert sa[3] == 3.0 and sa[4] == 12.0
+
+    def test_storage_mailbox_tolerates_legacy_3_slot_array(self):
+        cfg = small_config()
+        sa = np.zeros(3, np.float32)  # pre-ISSUE-2 mailbox shape
+        storage = LearnerStorage(cfg, handles=None, learner_port=0,
+                                 stat_array=sa)
+        storage._relay_stat({"mean": 1.0, "n": 50})
+        assert sa[2] == 1.0

@@ -22,7 +22,10 @@ def _machines(base_port: int) -> MachinesConfig:
         workers=[
             WorkerMachine(
                 num_p=2, manager_ip="127.0.0.1", ip="127.0.0.1",
-                port=base_port + 2,
+                # base+1 is the model broadcast, base+2 the centralized-
+                # inference ROUTER (MachinesConfig.inference_port): the
+                # worker relay port must clear both.
+                port=base_port + 5,
             )
         ],
     )
@@ -66,6 +69,40 @@ def test_local_cluster_end_to_end(tmp_path):
         assert not learner.proc.is_alive(), "learner never finished 6 updates"
         assert learner.proc.exitcode == 0
         # checkpoint appeared with the algo_{idx} naming
+        ckpts = os.listdir(tmp_path / "models")
+        assert any(name.startswith("PPO_") for name in ckpts), ckpts
+    finally:
+        sup.stop()
+
+
+@pytest.mark.timeout(300)
+def test_remote_acting_cluster_end_to_end(tmp_path):
+    """The SEED-style split as real processes: workers act via the learner-
+    colocated InferenceService (act_mode="remote", DEALER -> ROUTER on
+    inference_port) instead of their local policy, and the learner still
+    completes its update budget fed only by those remotely-acted rollouts.
+    The generous inference_timeout_ms keeps CI jit-compile latency from
+    silently triggering the local-acting fallback, which would let this
+    test pass without exercising the remote path."""
+    from tpu_rl.runtime.runner import local_cluster
+
+    cfg = _cluster_cfg(
+        tmp_path,
+        act_mode="remote",
+        inference_batch=4,
+        inference_flush_us=2000,
+        inference_timeout_ms=60_000,
+    )
+    sup = local_cluster(cfg, _machines(29800), max_updates=6)
+    try:
+        learner = next(c for c in sup.children if c.name == "learner")
+        deadline = time.time() + 240
+        while time.time() < deadline and learner.proc.is_alive():
+            time.sleep(1.0)
+        assert not learner.proc.is_alive(), (
+            "learner never finished 6 updates under remote acting"
+        )
+        assert learner.proc.exitcode == 0
         ckpts = os.listdir(tmp_path / "models")
         assert any(name.startswith("PPO_") for name in ckpts), ckpts
     finally:

@@ -247,6 +247,28 @@ class Config:
     # RolloutAssembler idle-trajectory drop window, seconds
     # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
     rollout_lag_sec: float = 0.5
+    # Acting placement (SEED RL / Podracer-Sebulba): "local" — each worker
+    # runs its own jitted policy forward on CPU (reference semantics);
+    # "remote" — workers ship observations to the centralized inference
+    # service colocated with the learner (runtime/inference_service.py),
+    # which batches requests across the fleet and runs ONE jitted act on
+    # the learner's device with zero-staleness params (swapped in-process
+    # after every update, no broadcast lag).
+    act_mode: str = "local"
+    # Dynamic-batch flush knobs for the inference service: a batch is
+    # dispatched when `inference_batch` observation rows are pending OR the
+    # oldest pending request is `inference_flush_us` microseconds old,
+    # whichever comes first. Bigger batch = better device utilization;
+    # shorter deadline = lower per-tick acting latency.
+    inference_batch: int = 64
+    inference_flush_us: int = 1000
+    # Remote-acting fault path: a worker whose inference request sees no
+    # reply within `inference_timeout_ms` resends up to `inference_retries`
+    # times, then falls back to LOCAL acting with its last-known params
+    # (logged once) — a dead inference server degrades throughput, it never
+    # wedges the fleet.
+    inference_timeout_ms: int = 2000
+    inference_retries: int = 2
 
     # ---- runtime-derived (filled by the runner, not the JSON) ----
     obs_shape: tuple[int, ...] = (4,)
@@ -290,6 +312,11 @@ class Config:
         )
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs
+        assert self.act_mode in ("local", "remote"), self.act_mode
+        assert self.inference_batch >= 1, self.inference_batch
+        assert self.inference_flush_us >= 0, self.inference_flush_us
+        assert self.inference_timeout_ms > 0, self.inference_timeout_ms
+        assert self.inference_retries >= 0, self.inference_retries
         assert self.action_repeat >= 1, self.action_repeat
         assert self.std_floor >= 0.0, (
             f"std_floor must be >= 0 (got {self.std_floor}): a negative floor "
@@ -437,6 +464,12 @@ class MachinesConfig:
         """Model-broadcast port = learner_port + 1 (reference
         ``agents/learner.py:88-90``)."""
         return self.learner_port + 1
+
+    @property
+    def inference_port(self) -> int:
+        """Centralized-inference ROUTER port = learner_port + 2 (the service
+        is colocated with the learner, ``runtime/inference_service.py``)."""
+        return self.learner_port + 2
 
 
 def default_result_dirs(base: str = "results") -> tuple[str, str]:

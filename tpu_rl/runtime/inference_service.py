@@ -1,0 +1,406 @@
+"""SEED-style centralized inference service: batched remote acting on the
+learner's device.
+
+New subsystem, no reference equivalent. The reference (and ``act_mode=
+"local"``) runs one jitted policy forward per worker process on host CPU —
+acting throughput scales only with host cores, and every worker acts on
+stale broadcast weights. SEED RL (Espeholt et al. 1910.06591) and the
+Podracer/Sebulba split (Hessel et al. 2104.06272) move inference onto the
+accelerator behind a batching server; workers become thin env-steppers.
+
+Design:
+
+- a ZMQ ROUTER (``transport.Router``) bound next to the learner collects
+  ``ObsRequest`` frames (one per worker tick: the tick's observations and
+  episode-first flags — the recurrent carry does NOT ride the request);
+- requests accumulate until ``Config.inference_batch`` observation rows are
+  pending or the oldest request is ``Config.inference_flush_us`` old, then
+  ONE jitted ``family.act`` runs over fixed padded batch slots on the
+  learner's device (fixed shape = exactly one XLA compile);
+- the recurrent carry (h/c) lives server-side per worker-env slot, zeroed
+  where the request flags an episode first — workers never maintain or ship
+  acting state. For ``store_carry`` families (LSTM) the *reply* carries the
+  pre-step carry rows, because the learner trains from them and they must
+  reach the RolloutBatch the worker publishes;
+- params are swapped in-process by the learner (``set_params`` after every
+  update): remote acting is ZERO-staleness — no model broadcast lag, no
+  codec, no wire copy. (The model PUB channel stays up regardless: it feeds
+  the worker's local-fallback path and any late local-mode joiners.)
+
+The service runs as a daemon thread inside the learner process so the param
+handoff is a pointer swap. It is transport-complete on its own (tests run it
+against synthetic Dealer clients without a learner).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from tpu_rl.config import Config
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.transport import Router
+from tpu_rl.utils.timer import ExecutionTimer
+
+
+class _ClientState:
+    """Per-DEALER-identity acting state: the env-slot carries (device
+    arrays) and the row count the client established on first contact."""
+
+    __slots__ = ("n", "h", "c")
+
+    def __init__(self, n: int, h, c):
+        self.n = n
+        self.h = h
+        self.c = c
+
+
+class _Pending:
+    __slots__ = ("identity", "seq", "obs", "first", "arrived")
+
+    def __init__(self, identity: bytes, seq: int, obs, first, arrived: float):
+        self.identity = identity
+        self.seq = seq
+        self.obs = obs
+        self.first = first
+        self.arrived = arrived
+
+
+class InferenceService:
+    """Batched acting server. ``start()`` spawns the serve thread;
+    ``set_params`` swaps the policy in-process (zero staleness);
+    ``close()`` shuts the thread down and releases the socket.
+
+    ``timer`` (optional, shared with the learner's ``ExecutionTimer``)
+    receives ``inference-batch-size`` / ``inference-wait-rows`` gauges and
+    the ``inference-step-time`` span, so the service shows up on the same
+    tensorboard dashboards as the learner hot loop.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        family,
+        params,
+        port: int,
+        ip: str = "*",
+        timer: ExecutionTimer | None = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.family = family
+        self._params = params
+        self.addr = (ip, port)
+        self.timer = timer or ExecutionTimer()
+        self.seed = seed
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._ready = threading.Event()  # set once compiled and serving
+        self._lock = threading.Lock()  # guards the params slot
+        self.clients: dict[bytes, _ClientState] = {}
+        # observability counters
+        self.n_requests = 0
+        self.n_replies = 0
+        self.n_batches = 0
+        self.n_flush_full = 0
+        self.n_flush_deadline = 0
+        self.n_rejected_payload = 0
+        self.error: BaseException | None = None
+        self._jnp = None  # bound by the serve thread (deferred jax import)
+
+    # --------------------------------------------------------------- control
+    def start(self) -> "InferenceService":
+        self._thread = threading.Thread(
+            target=self._serve, name="inference-service", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def wait_ready(self, timeout: float = 60.0) -> bool:
+        """Block until the act program is compiled and the socket is bound
+        (first-request latency then excludes the XLA compile)."""
+        return self._ready.wait(timeout)
+
+    def set_params(self, params) -> None:
+        """In-process param swap from the learner — a reference assignment
+        of the device pytree, no copy, no wire. The NEXT flushed batch acts
+        with the new weights."""
+        with self._lock:
+            self._params = params
+
+    def close(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ----------------------------------------------------------------- serve
+    def _serve(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        cfg = self.cfg
+        family = self.family
+        act = family.act
+        store_carry = family.store_carry
+        pad_rows = max(cfg.inference_batch, cfg.worker_num_envs)
+        hw, cw = family.carry_widths
+        obs_dim = int(cfg.obs_shape[0])
+
+        def _step(params, obs, h, c, first, key):
+            # Zero the carry rows whose env just reset (server-side episode
+            # seam — the request's `first` flag is the only state the worker
+            # contributes). The zeroed PRE-step carry is what local workers
+            # store into the RolloutBatch, so it is returned alongside the
+            # post-step carry.
+            keep = (first < 0.5)[:, None]
+            h = jnp.where(keep, h, 0.0)
+            c = jnp.where(keep, c, 0.0)
+            a, logits, log_prob, h2, c2 = act(params, obs, h, c, key)
+            return a, logits, log_prob, h, c, h2, c2
+
+        step = jax.jit(_step)
+
+        router = None
+        try:
+            # Compile at the padded shape BEFORE binding the socket: the
+            # first real request must never eat the XLA compile inside the
+            # workers' inference_timeout_ms window.
+            zeros = (
+                jnp.zeros((pad_rows, obs_dim)),
+                jnp.zeros((pad_rows, hw)),
+                jnp.zeros((pad_rows, cw)),
+                jnp.zeros((pad_rows,)),
+            )
+            with self._lock:
+                params = self._params
+            jax.block_until_ready(
+                step(params, *zeros, jax.random.key(self.seed))
+            )
+
+            router = Router(*self.addr, bind=True)
+            key = jax.random.key(self.seed * 7919 + 17)
+            pending: list[_Pending] = []
+            pending_rows = 0
+            flush_s = cfg.inference_flush_us / 1e6
+            self._ready.set()
+
+            while not self._stop.is_set():
+                # Bounded poll: until the flush deadline when requests are
+                # pending, a housekeeping tick otherwise.
+                if pending:
+                    budget = flush_s - (time.perf_counter() - pending[0].arrived)
+                    timeout_ms = max(0, int(budget * 1e3))
+                else:
+                    timeout_ms = 20
+                got = router.recv(timeout_ms=timeout_ms)
+                if got is not None:
+                    req = self._ingest(*got)
+                    if req is not None:
+                        pending.append(req)
+                        pending_rows += req.obs.shape[0]
+                    for parts in router.drain():
+                        req = self._ingest(*parts)
+                        if req is not None:
+                            pending.append(req)
+                            pending_rows += req.obs.shape[0]
+                if not pending:
+                    continue
+                full = pending_rows >= cfg.inference_batch
+                expired = (
+                    time.perf_counter() - pending[0].arrived >= flush_s
+                )
+                if not (full or expired):
+                    continue
+                self.n_flush_full += 1 if full else 0
+                self.n_flush_deadline += 0 if full else 1
+                # Flush whole-client chunks of at most pad_rows rows; a
+                # burst larger than one padded program drains over several
+                # back-to-back dispatches.
+                while pending:
+                    chunk, rows = [], 0
+                    while pending and rows + pending[0].obs.shape[0] <= pad_rows:
+                        req = pending.pop(0)
+                        chunk.append(req)
+                        rows += req.obs.shape[0]
+                    pending_rows -= rows
+                    key, sub = jax.random.split(key)
+                    self._flush(
+                        router, step, chunk, rows, pad_rows, sub,
+                        store_carry, jnp,
+                    )
+                    if rows < cfg.inference_batch:
+                        break  # partial tail came from the deadline, done
+        except BaseException as e:  # noqa: BLE001 — surfaced via .error
+            self.error = e
+            self._ready.set()  # never leave wait_ready() hanging
+            raise
+        finally:
+            if router is not None:
+                router.close()
+
+    # ---------------------------------------------------------------- ingest
+    def _ingest(self, identity: bytes, proto: Protocol, payload
+                ) -> _Pending | None:
+        """Validate one request; establish the client's carry slots on first
+        contact. Malformed-but-decodable payloads are dropped (counted on the
+        router's reject counter semantics: a bad client must not kill the
+        fleet's acting path)."""
+        if proto != Protocol.ObsRequest or not isinstance(payload, dict):
+            self.n_rejected_payload += 1
+            return None
+        try:
+            obs = np.asarray(payload["obs"], np.float32)
+            first = np.asarray(payload["first"], np.float32).reshape(-1)
+            seq = int(payload["seq"])
+        except (KeyError, TypeError, ValueError):
+            self.n_rejected_payload += 1
+            return None
+        if obs.ndim != 2 or obs.shape[0] != first.shape[0]:
+            self.n_rejected_payload += 1
+            return None
+        self.n_requests += 1
+        client = self.clients.get(identity)
+        if client is None or client.n != obs.shape[0]:
+            jnp = self._jnp
+            hw, cw = self.family.carry_widths
+            n = obs.shape[0]
+            client = _ClientState(
+                n, jnp.zeros((n, hw)), jnp.zeros((n, cw))
+            )
+            self.clients[identity] = client
+        return _Pending(identity, seq, obs, first, time.perf_counter())
+
+    # ----------------------------------------------------------------- flush
+    def _flush(self, router, step, chunk, rows, pad_rows, key,
+               store_carry, jnp) -> None:
+        t0 = time.perf_counter()
+        obs = np.zeros((pad_rows, chunk[0].obs.shape[1]), np.float32)
+        first = np.ones((pad_rows,), np.float32)  # pad slots: reset carry
+        off = 0
+        offsets = []
+        for req in chunk:
+            n = req.obs.shape[0]
+            obs[off:off + n] = req.obs
+            first[off:off + n] = req.first
+            offsets.append(off)
+            off += n
+        hw, cw = self.family.carry_widths
+        h_parts = [self.clients[r.identity].h for r in chunk]
+        c_parts = [self.clients[r.identity].c for r in chunk]
+        if rows < pad_rows:
+            h_parts.append(jnp.zeros((pad_rows - rows, hw)))
+            c_parts.append(jnp.zeros((pad_rows - rows, cw)))
+        h = jnp.concatenate(h_parts)
+        c = jnp.concatenate(c_parts)
+        with self._lock:
+            params = self._params
+        a, logits, log_prob, h_pre, c_pre, h2, c2 = step(
+            params, jnp.asarray(obs), h, c, jnp.asarray(first), key
+        )
+        # One host transfer for the whole batch; per-client row slices view it.
+        a_np = np.asarray(a)
+        logits_np = np.asarray(logits)
+        lp_np = np.asarray(log_prob)
+        h_pre_np = np.asarray(h_pre) if store_carry else None
+        c_pre_np = np.asarray(c_pre) if store_carry else None
+        for req, off in zip(chunk, offsets):
+            n = req.obs.shape[0]
+            client = self.clients[req.identity]
+            # lax.dynamic_slice-free row updates: device-side slicing keeps
+            # the carries as device arrays between ticks.
+            client.h = h2[off:off + n]
+            client.c = c2[off:off + n]
+            reply = {
+                "seq": req.seq,
+                "act": a_np[off:off + n],
+                "logits": logits_np[off:off + n],
+                "log_prob": lp_np[off:off + n],
+            }
+            if store_carry:
+                reply["hx"] = h_pre_np[off:off + n]
+                reply["cx"] = c_pre_np[off:off + n]
+            router.send(req.identity, Protocol.Act, reply)
+            self.n_replies += 1
+        self.n_batches += 1
+        self.timer.record_gauge("inference-batch-size", rows)
+        self.timer.record("inference-step-time", time.perf_counter() - t0)
+
+
+class InferenceClient:
+    """Worker-side remote-acting client: one in-flight request per tick
+    (send then timed receive), correlated by a monotonically increasing
+    ``seq`` echo — stale replies (a retry's ghost) are skipped by seq.
+
+    ``act`` returns the reply payload dict, or None once
+    ``Config.inference_retries`` retries have all timed out
+    (``Config.inference_timeout_ms`` each) — the caller's cue to fall back
+    to local acting. Retries resend the same seq: if the server actually
+    served the lost reply, its carry advanced once more than the episode —
+    a policy-lag-sized smudge on a fault path the IS corrections absorb.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        ip: str,
+        port: int,
+        wid: int = 0,
+        identity: bytes | None = None,
+        timer: ExecutionTimer | None = None,
+    ):
+        import uuid
+
+        self.cfg = cfg
+        self.wid = wid
+        self.timer = timer
+        self.seq = 0
+        self.n_timeouts = 0
+        # Identity must be unique per socket across worker restarts: a
+        # restarted worker reusing a dead identity would inherit the old
+        # carry rows AND zmq may still route the dead peer's queue.
+        from tpu_rl.runtime.transport import Dealer
+
+        self.dealer = Dealer(
+            ip, port,
+            identity=identity or f"w{wid}-{uuid.uuid4().hex[:8]}".encode(),
+        )
+
+    def act(self, obs: np.ndarray, first: np.ndarray) -> dict | None:
+        cfg = self.cfg
+        req = {"wid": self.wid, "seq": self.seq, "obs": obs, "first": first}
+        t0 = time.perf_counter()
+        try:
+            for _attempt in range(cfg.inference_retries + 1):
+                self.dealer.send(Protocol.ObsRequest, req)
+                deadline = time.perf_counter() + cfg.inference_timeout_ms / 1e3
+                while True:
+                    left_ms = int((deadline - time.perf_counter()) * 1e3)
+                    if left_ms <= 0:
+                        break
+                    got = self.dealer.recv(timeout_ms=left_ms)
+                    if got is None:
+                        continue  # rejected frame burned some budget; keep waiting
+                    proto, payload = got
+                    if proto != Protocol.Act or not isinstance(payload, dict):
+                        continue
+                    if payload.get("seq") != self.seq:
+                        continue  # stale ghost from an earlier retry
+                    if self.timer is not None:
+                        self.timer.record(
+                            "inference-rtt", time.perf_counter() - t0
+                        )
+                    return payload
+                self.n_timeouts += 1
+            return None
+        finally:
+            self.seq += 1
+
+    def close(self) -> None:
+        self.dealer.close()

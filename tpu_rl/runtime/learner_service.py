@@ -137,6 +137,7 @@ class LearnerService:
         max_updates: int | None = None,
         publish_interval: int = 1,
         seed: int = 0,
+        inference_port: int | None = None,
     ):
         self.cfg = cfg
         self.handles = handles
@@ -147,7 +148,9 @@ class LearnerService:
         self.max_updates = max_updates
         self.publish_interval = publish_interval
         self.seed = seed
+        self.inference_port = inference_port
         self._publisher: AsyncPublisher | None = None
+        self._inference = None  # InferenceService when act_mode="remote"
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -289,6 +292,25 @@ class LearnerService:
         )
         key = jax.random.key(self.seed + 1)
 
+        # SEED-style centralized inference (act_mode="remote"): serve
+        # batched acting from THIS process on the learner's device. Params
+        # reach the service as a device-side snapshot after every update —
+        # zero broadcast staleness, no host copy, no wire. The service
+        # shares `timer`, so inference-batch-size / inference-step-time land
+        # on the learner's tensorboard alongside the hot-loop timings.
+        if cfg.act_mode == "remote" and self.inference_port is not None:
+            from tpu_rl.runtime.inference_service import InferenceService
+
+            self._inference = InferenceService(
+                cfg,
+                family,
+                self._actor_snapshot(state),
+                self.inference_port,
+                timer=timer,
+                seed=self.seed,
+            ).start()
+            self._inference.wait_ready()
+
         # First broadcast so workers act with the resumed/initial policy
         # rather than their own random init.
         self._publish(pub, state)
@@ -339,6 +361,11 @@ class LearnerService:
                 key, sub_key = jax.random.split(key)
                 state, metrics = train_step(state, batch, sub_key)
                 step_secs = time.perf_counter() - t_step
+                if self._inference is not None:
+                    # Snapshot (not reference): the NEXT dispatch donates
+                    # this state's buffers, and the serve thread must never
+                    # act on deleted arrays.
+                    self._inference.set_params(self._actor_snapshot(state))
                 # learner-batching-time is the feed-side host work (shm
                 # copies + assembly + H2D placement). With prefetch it
                 # overlaps the device step, so the per-dispatch critical
@@ -420,6 +447,8 @@ class LearnerService:
             # Feeder first (stops shm sampling), then the publisher (joins
             # its thread, flushing the final snapshot — the Pub socket is
             # only safe to close once no other thread can touch it).
+            if self._inference is not None:
+                self._inference.close()
             feed.close()
             if self._publisher is not None:
                 self._publisher.close()
@@ -530,6 +559,20 @@ class LearnerService:
         return Batch.from_mapping(raw)
 
     # ------------------------------------------------------------ broadcast
+    def _actor_snapshot(self, state) -> dict:
+        """Donation-proof device copy of the actor tree, shaped as the
+        ``{"actor": ...}`` pytree ``family.act`` consumes (the same contract
+        workers build from the model broadcast)."""
+        import jax
+        import jax.numpy as jnp
+
+        actor = (
+            state.actor_params
+            if hasattr(state, "actor_params")
+            else state.params["actor"]
+        )
+        return {"actor": jax.tree.map(jnp.copy, actor)}
+
     def _publish(self, pub: Pub, state) -> None:
         """Ship the actor tree as host numpy (SAC broadcasts the actor only,
         reference ``sac/learning.py:145``). With the async publisher the
@@ -553,6 +596,15 @@ class LearnerService:
         sa = self.stat_array
         if sa is not None and sa[2] >= 1.0:
             logger.log_stat(int(sa[0]), float(sa[1]))
+            if len(sa) > 4:
+                # Fleet-health slots (storage._relay_stat): corrupt-frame
+                # drops across every transport hop, and worker model-reload
+                # totals — exported as timer gauges so they reach the same
+                # dashboards as the loop timings.
+                self.timer.record_gauge(
+                    "transport-rejected-frames", float(sa[3])
+                )
+                self.timer.record_gauge("worker-model-loads", float(sa[4]))
             sa[2] = 0.0
 
     def _stopped(self) -> bool:
@@ -569,6 +621,7 @@ def learner_main(
     max_updates=None,
     publish_interval: int = 1,
     seed: int = 0,
+    inference_port: int | None = None,
 ) -> None:
     """mp.Process target (reference ``run_learner``, ``main.py:189-226``)."""
     LearnerService(
@@ -581,4 +634,5 @@ def learner_main(
         max_updates,
         publish_interval,
         seed,
+        inference_port=inference_port,
     ).run()

@@ -46,9 +46,16 @@ class Manager:
         self.stat_q: deque = deque(maxlen=STAT_WINDOW)
         self.n_stats = 0
         self.n_forwarded = 0
+        # Per-worker health counters (last-seen cumulative values, keyed by
+        # wid) relayed in the windowed stat publish so they reach the
+        # learner's dashboards (ISSUE 2 satellites: n_model_loads,
+        # n_rejected visibility).
+        self.model_loads: dict = {}
+        self.worker_rejected: dict = {}
+        self._sub: Sub | None = None
 
     def run(self) -> None:
-        sub = Sub("*", self.worker_port, bind=True)
+        sub = self._sub = Sub("*", self.worker_port, bind=True)
         pub = Pub(*self.learner_addr, bind=False)
         try:
             while not self._stopped():
@@ -86,12 +93,30 @@ class Manager:
             # most stale together.
             self.queue.append((proto, payload))  # drop-oldest at maxlen
         elif proto == Protocol.Stat:
-            self.stat_q.append(float(payload))
+            # Workers send either the reference's bare episode reward or the
+            # dict form carrying per-worker health counters.
+            if isinstance(payload, dict):
+                self.stat_q.append(float(payload.get("rew", 0.0)))
+                wid = payload.get("wid", -1)
+                self.model_loads[wid] = int(payload.get("n_model_loads", 0))
+                self.worker_rejected[wid] = int(payload.get("n_rejected", 0))
+            else:
+                self.stat_q.append(float(payload))
             self.n_stats += 1
             if self.n_stats % STAT_WINDOW == 0:
                 mean = sum(self.stat_q) / len(self.stat_q)
+                own_rejected = self._sub.n_rejected if self._sub else 0
                 pub.send(
-                    Protocol.Stat, {"mean": mean, "n": len(self.stat_q)}
+                    Protocol.Stat,
+                    {
+                        "mean": mean,
+                        "n": len(self.stat_q),
+                        # Fleet totals: this relay's own corrupt-frame drops
+                        # plus every worker's model-SUB drops / reloads.
+                        "rejected": own_rejected
+                        + sum(self.worker_rejected.values()),
+                        "model_loads": sum(self.model_loads.values()),
+                    },
                 )
 
     def _stopped(self) -> bool:

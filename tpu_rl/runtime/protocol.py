@@ -260,6 +260,16 @@ class Protocol(enum.IntEnum):
     # the stacked arrays compress far better). Split back into per-step
     # dicts by ``tpu_rl.data.assembler.split_rollout_batch``.
     RolloutBatch = 3
+    # SEED-style centralized inference (runtime/inference_service.py):
+    # worker DEALER -> learner ROUTER, one frame per worker tick carrying
+    # the tick's observations {"wid", "seq", "obs" (n, obs_dim),
+    # "first" (n,)} — the recurrent carry stays server-side, it never
+    # rides this request.
+    ObsRequest = 4
+    # The reply: {"seq", "act", "logits", "log_prob"} (+ "hx"/"cx" pre-step
+    # carry rows for store_carry families — the learner trains from them,
+    # so they must reach the RolloutBatch the worker publishes).
+    Act = 5
 
 
 class Codec(enum.IntEnum):

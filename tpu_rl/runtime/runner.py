@@ -243,6 +243,11 @@ def learner_role(
             max_updates=max_updates,
             publish_interval=publish_interval,
             seed=seed,
+            # The centralized-inference ROUTER (act_mode="remote") binds in
+            # the learner process; the service itself gates on act_mode.
+            inference_port=(
+                machines.inference_port if cfg.act_mode == "remote" else None
+            ),
         ),
         cfg,
         handles,
@@ -285,6 +290,10 @@ def worker_role(
                 worker_main,
                 seed=seed * 1000 + machine_idx * 100 + i,
                 initial_params=initial_params,
+                inference_port=(
+                    machines.inference_port
+                    if cfg.act_mode == "remote" else None
+                ),
             ),
             cfg,
             i,

@@ -20,7 +20,11 @@ from tpu_rl.data.shm_ring import ShmHandles, make_store
 from tpu_rl.runtime.protocol import Protocol
 from tpu_rl.runtime.transport import Sub
 
-STAT_SLOTS = 3  # [game_count, mean_rew, activate]
+# [game_count, mean_rew, activate, rejected_frames, model_loads] — the first
+# three are the reference's 3-float mailbox (``main.py:324-326``); the fleet
+# health slots (transport corrupt-frame drops, worker model reloads) ride the
+# same activate flag and become learner timer gauges (ISSUE 2 satellites).
+STAT_SLOTS = 5
 
 
 class LearnerStorage:
@@ -42,13 +46,14 @@ class LearnerStorage:
         self.game_count = 0
         self.n_windows = 0
         self.n_requeue_full = 0  # windows requeued because the store was full
+        self._sub: Sub | None = None
 
     def run(self) -> None:
         cfg = self.cfg
         layout = BatchLayout.from_config(cfg)
         assembler = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
         store = make_store(cfg, layout, handles=self.handles)
-        sub = Sub("*", self.learner_port, bind=True)
+        sub = self._sub = Sub("*", self.learner_port, bind=True)
         try:
             while not self._stopped():
                 msg = sub.recv(timeout_ms=50)
@@ -96,6 +101,21 @@ class LearnerStorage:
         self.game_count += n
         self.stat_array[0] = float(self.game_count)
         self.stat_array[1] = mean
+        if len(self.stat_array) > 4:
+            # Fleet health: manager-relayed totals (worker model-SUB drops +
+            # the relay's own) plus THIS sub's corrupt-frame count — every
+            # transport hop is covered. Written before the activate flag so
+            # the learner never reads a half-updated mailbox.
+            own = self._sub.n_rejected if self._sub is not None else 0
+            relayed = (
+                float(payload.get("rejected", 0.0))
+                if isinstance(payload, dict) else 0.0
+            )
+            self.stat_array[3] = relayed + own
+            self.stat_array[4] = (
+                float(payload.get("model_loads", 0.0))
+                if isinstance(payload, dict) else 0.0
+            )
         self.stat_array[2] = 1.0  # activate flag; learner clears it
 
     def _stopped(self) -> bool:

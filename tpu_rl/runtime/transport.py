@@ -102,6 +102,105 @@ class Sub:
         self.sock.close(linger=0)
 
 
+class Router:
+    """ROUTER endpoint for the centralized inference service (new capability,
+    no reference equivalent — the SEED RL request/reply pattern).
+
+    Unlike PUB/SUB, ROUTER/DEALER is connection-addressed: every frame a
+    DEALER sends arrives prefixed with that peer's identity, and a reply sent
+    to the same identity routes back to exactly that peer. Malformed frames
+    are dropped and counted (``n_rejected``), same contract as :class:`Sub` —
+    one corrupt client must not crash the inference server."""
+
+    def __init__(self, ip: str, port: int, bind: bool = True,
+                 hwm: int = DATA_HWM, ctx=None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.ROUTER)
+        self.sock.set_hwm(hwm)
+        self.n_rejected = 0
+        ep = _endpoint(ip, port)
+        self.sock.bind(ep) if bind else self.sock.connect(ep)
+
+    def recv(self, timeout_ms: int | None = None
+             ) -> tuple[bytes, Protocol, Any] | None:
+        """One ``(identity, proto, payload)`` request; None on timeout or on
+        a rejected frame."""
+        if timeout_ms is not None:
+            if not self.sock.poll(timeout_ms):
+                return None
+        parts = self.sock.recv_multipart()
+        return self._split(parts)
+
+    def drain(self, max_msgs: int = 1024
+              ) -> Iterator[tuple[bytes, Protocol, Any]]:
+        """Yield every decodable queued request, newest-bounded."""
+        for _ in range(max_msgs):
+            try:
+                parts = self.sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            got = self._split(parts)
+            if got is not None:
+                yield got
+
+    def _split(self, parts: list[bytes]
+               ) -> tuple[bytes, Protocol, Any] | None:
+        # ROUTER prepends the peer identity to whatever the DEALER sent.
+        try:
+            if len(parts) < 2:
+                raise ValueError(f"short ROUTER frame: {len(parts)} parts")
+            proto, payload = decode(parts[1:])
+            return parts[0], proto, payload
+        except ValueError:
+            self.n_rejected += 1
+            return None
+
+    def send(self, identity: bytes, proto: Protocol, payload: Any) -> None:
+        """Route one reply back to ``identity``. A vanished peer is a normal
+        fleet event (worker died between request and reply): with
+        ROUTER_MANDATORY unset zmq silently drops the frame, which is the
+        behavior we want on a best-effort fabric."""
+        self.sock.send_multipart([identity, *encode(proto, payload)])
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
+class Dealer:
+    """DEALER endpoint: the worker side of the inference channel. One
+    in-flight request per tick (send -> timed recv), so no correlation
+    machinery beyond the payload's own ``seq`` echo is needed."""
+
+    def __init__(self, ip: str, port: int, bind: bool = False,
+                 hwm: int = DATA_HWM, identity: bytes | None = None, ctx=None):
+        self._ctx = ctx or zmq.Context.instance()
+        self.sock = self._ctx.socket(zmq.DEALER)
+        self.sock.set_hwm(hwm)
+        if identity is not None:
+            self.sock.setsockopt(zmq.IDENTITY, identity)
+        self.n_rejected = 0
+        ep = _endpoint(ip, port)
+        self.sock.bind(ep) if bind else self.sock.connect(ep)
+
+    def send(self, proto: Protocol, payload: Any) -> None:
+        self.sock.send_multipart(encode(proto, payload))
+
+    def recv(self, timeout_ms: int | None = None) -> tuple[Protocol, Any] | None:
+        """Timed receive of one decoded reply; None on timeout or on a
+        rejected frame."""
+        if timeout_ms is not None:
+            if not self.sock.poll(timeout_ms):
+                return None
+        try:
+            return decode(self.sock.recv_multipart())
+        except ValueError:
+            self.n_rejected += 1
+            return None
+
+    def close(self) -> None:
+        self.sock.close(linger=0)
+
+
 class AsyncSub:
     """asyncio SUB endpoint (storage/manager event loops, reference
     ``zmq.asyncio`` usage)."""

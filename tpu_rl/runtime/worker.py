@@ -16,10 +16,26 @@ swap is one pointer assignment, never a mid-step mutation
 
 Workers are CPU processes by design — the learner owns the TPU; the runner
 forces ``JAX_PLATFORMS=cpu`` into worker/manager/storage children.
+
+``Config.act_mode`` selects the acting path (SEED RL / Podracer split):
+
+- ``"local"``: the loop above — jitted policy forward on the worker's host
+  CPU against the freshest broadcast params;
+- ``"remote"``: the tick's observations go to the learner-colocated
+  :class:`~tpu_rl.runtime.inference_service.InferenceService` over a
+  DEALER/ROUTER channel; actions/logits/log_prob (and, for ``store_carry``
+  families, the pre-step carry rows) come back and the published
+  RolloutBatch is **bit-identical in layout** to local mode — manager,
+  storage, assembler and algorithms cannot tell the modes apart. If the
+  service times out ``inference_retries`` times the worker logs once and
+  permanently falls back to local acting on its last-known broadcast params
+  (the model SUB is drained in both modes precisely so this fallback never
+  acts on init-fresh weights).
 """
 
 from __future__ import annotations
 
+import sys
 import time
 import uuid
 
@@ -44,6 +60,7 @@ class Worker:
         heartbeat=None,
         initial_params=None,
         seed: int = 0,
+        inference_port: int | None = None,
     ):
         self.cfg = cfg
         self.worker_id = worker_id
@@ -52,6 +69,9 @@ class Worker:
         self.heartbeat = heartbeat
         self.initial_params = initial_params
         self.seed = seed
+        self.inference_port = inference_port
+        self.fell_back = False  # remote acting permanently abandoned
+        self.n_remote_acts = 0
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -73,6 +93,17 @@ class Worker:
             key, init_key = jax.random.split(key)
             params = family.init_params(init_key, seq_len=cfg.seq_len)
         act = jax.jit(family.act)
+
+        # Remote acting (act_mode="remote"): ship obs to the learner-device
+        # inference service, fall back to the local jitted path above if it
+        # ever becomes unreachable.
+        remote = None
+        if cfg.act_mode == "remote" and self.inference_port is not None:
+            from tpu_rl.runtime.inference_service import InferenceClient
+
+            remote = InferenceClient(
+                cfg, learner_ip, self.inference_port, wid=self.worker_id
+            )
 
         # Vectorized acting: N envs stepped per tick with ONE batched policy
         # forward (worker_num_envs; N=1 reproduces the reference's
@@ -111,15 +142,51 @@ class Worker:
                         params = {"actor": payload["actor"]}
                         n_model_loads += 1
 
-                key, sub_key = jax.random.split(key)
-                a, logits, log_prob, h2, c2 = act(
-                    params, jnp.asarray(obs), h, c, sub_key
-                )
-                a_np = np.asarray(a)
-                logits_np = np.asarray(logits)
-                lp_np = np.asarray(log_prob)
-                h_np = np.asarray(h) if family.store_carry else None
-                c_np = np.asarray(c) if family.store_carry else None
+                reply = remote.act(obs, is_fir) if remote is not None else None
+                if remote is not None and reply is None:
+                    # Fault path: the service timed out through every retry.
+                    # Log ONCE, drop to local acting on the last broadcast
+                    # params for the rest of this worker's life — a dead
+                    # server must never wedge the fleet.
+                    print(
+                        f"[worker {self.worker_id}] inference service "
+                        f"unreachable after "
+                        f"{cfg.inference_retries + 1} attempts of "
+                        f"{cfg.inference_timeout_ms} ms; falling back to "
+                        f"local acting",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    remote.close()
+                    remote = None
+                    self.fell_back = True
+                if reply is not None:
+                    # The service already sampled on the learner's device;
+                    # for store_carry families the reply carries the
+                    # pre-step carry rows the learner trains from (the
+                    # running carry itself stays server-side).
+                    self.n_remote_acts += 1
+                    a_np = np.asarray(reply["act"], np.float32)
+                    logits_np = np.asarray(reply["logits"], np.float32)
+                    lp_np = np.asarray(reply["log_prob"], np.float32)
+                    h_np = (
+                        np.asarray(reply["hx"], np.float32)
+                        if family.store_carry else None
+                    )
+                    c_np = (
+                        np.asarray(reply["cx"], np.float32)
+                        if family.store_carry else None
+                    )
+                else:
+                    key, sub_key = jax.random.split(key)
+                    a, logits, log_prob, h2, c2 = act(
+                        params, jnp.asarray(obs), h, c, sub_key
+                    )
+                    a_np = np.asarray(a)
+                    logits_np = np.asarray(logits)
+                    lp_np = np.asarray(log_prob)
+                    h_np = np.asarray(h) if family.store_carry else None
+                    c_np = np.asarray(c) if family.store_carry else None
 
                 # One framed RolloutBatch per tick: step every env, stack
                 # the tick's transitions, send ONCE (per-env sends were
@@ -142,7 +209,19 @@ class Worker:
                     is_fir[i] = 0.0
                     obs[i] = next_ob
                     if done or horizon_hit:
-                        pub.send(Protocol.Stat, float(epi_rew[i]))
+                        # Episode stat rides as a dict so per-worker health
+                        # counters (model reloads — satellite of ISSUE 2)
+                        # reach the dashboards; the manager also accepts the
+                        # reference's bare-float form.
+                        pub.send(
+                            Protocol.Stat,
+                            {
+                                "rew": float(epi_rew[i]),
+                                "n_model_loads": n_model_loads,
+                                "n_rejected": model_sub.n_rejected,
+                                "wid": self.worker_id,
+                            },
+                        )
                         obs[i] = env.reset()
                         episode_ids[i] = uuid.uuid4().hex
                         is_fir[i], epi_rew[i], epi_steps[i] = 1.0, 0.0, 0
@@ -165,12 +244,15 @@ class Worker:
                 # Carry forward; zero only the rows whose episode ended
                 # (where(), not multiply: a transient NaN in a dying
                 # episode's carry must not survive the reset as NaN*0).
-                if dones.any():
-                    keep = jnp.asarray(dones == 0)[:, None]
-                    h = jnp.where(keep, h2, 0.0)
-                    c = jnp.where(keep, c2, 0.0)
-                else:
-                    h, c = h2, c2
+                # Remote ticks skip this: the carry lives server-side and
+                # the next request's is_fir flags do the zeroing there.
+                if reply is None:
+                    if dones.any():
+                        keep = jnp.asarray(dones == 0)[:, None]
+                        h = jnp.where(keep, h2, 0.0)
+                        c = jnp.where(keep, c2, 0.0)
+                    else:
+                        h, c = h2, c2
 
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
@@ -184,6 +266,8 @@ class Worker:
                 env.close()
             pub.close()
             model_sub.close()
+            if remote is not None:
+                remote.close()
 
     def _stopped(self) -> bool:
         return self.stop_event is not None and self.stop_event.is_set()
@@ -200,6 +284,7 @@ def worker_main(
     heartbeat,
     initial_params=None,
     seed: int = 0,
+    inference_port: int | None = None,
 ) -> None:
     """mp.Process target (reference ``worker_run``, ``main.py:155-162``)."""
     Worker(
@@ -213,4 +298,5 @@ def worker_main(
         heartbeat,
         initial_params,
         seed,
+        inference_port=inference_port,
     ).run()
