@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint format-check test ci
+.PHONY: lint format-check test relay-smoke ci
 
 lint:
 	ruff check .
@@ -15,4 +15,12 @@ test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
-ci: lint test
+# Fan-in A/B smoke: short raw-vs-decode run through the real Manager +
+# LearnerStorage. Asserts direction only (raw >= decode frames/s) — never a
+# committed number, so CI load can't make it flap. Full capture:
+# TPU_RL_BENCH_RELAY=1 python bench.py  (writes bench_relay[.cpu].json).
+relay-smoke:
+	JAX_PLATFORMS=cpu TPU_RL_BENCH_RELAY=1 TPU_RL_BENCH_RELAY_LIGHT=1 \
+		$(PY) bench.py > /dev/null
+
+ci: lint test relay-smoke

@@ -31,6 +31,12 @@ is never clobbered by fallback or partial numbers.
 ``TPU_RL_BENCH_E2E=1 python bench.py`` runs the e2e FEED comparison instead:
 the production LearnerService through the real shm path, synchronous vs
 prefetched data plane (``run_e2e_compare`` -> ``bench_e2e_feed[.cpu].json``).
+
+``TPU_RL_BENCH_RELAY=1 python bench.py`` runs the fan-in A/B: raw (zero-copy
+peek+forward relay, columnar push_tick ingest) vs decode baseline through the
+real Manager and LearnerStorage (``run_relay_compare`` ->
+``bench_relay[.cpu].json``; ``TPU_RL_BENCH_RELAY_LIGHT=1`` is the `make ci`
+smoke shape, asserting direction without writing numbers).
 """
 
 from __future__ import annotations
@@ -732,6 +738,202 @@ def run_act_compare(
     return result
 
 
+# ------------------------------------------------------------- relay A/B
+def _relay_tick_payload(n_envs: int = 32, hidden: int = 64) -> dict:
+    """One worker tick at the reference quantum (CartPole (4,)/2 discrete,
+    hidden 64): the RolloutBatch frame shape the relay A/B is specified
+    against (32-env reference tick)."""
+    rng = np.random.default_rng(0)
+    col = lambda w: rng.standard_normal((n_envs, w)).astype(np.float32)  # noqa: E731
+    return dict(
+        obs=col(4), act=col(1), rew=col(1), logits=col(2), log_prob=col(1),
+        is_fir=col(1), hx=col(hidden), cx=col(hidden),
+        id=[f"bench-ep{i:02d}" for i in range(n_envs)],
+        done=np.zeros(n_envs, np.uint8),
+    )
+
+
+def relay_forward_row(mode: str, base_port: int, duration: float,
+                      payload: dict) -> dict:
+    """Frames/s through a REAL Manager over real ZMQ: a producer PUB floods
+    pre-encoded RolloutBatch frames at the manager's worker port while a
+    sink SUB (bound where storage binds) counts what comes out the other
+    side. The producer and sink are identical across modes — the only
+    variable is the manager's per-frame work: peek+forward (raw) vs
+    decode+re-encode (decode)."""
+    import threading
+
+    from tpu_rl.config import Config
+    from tpu_rl.runtime.manager import Manager
+    from tpu_rl.runtime.protocol import Protocol, encode
+    from tpu_rl.runtime.transport import Pub, Sub
+
+    cfg = Config.from_dict(
+        dict(algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=64,
+             relay_mode=mode)
+    )
+    worker_port, learner_port = base_port, base_port + 1
+    stop = threading.Event()
+    m = Manager(cfg, worker_port, "127.0.0.1", learner_port, stop_event=stop)
+    mt = threading.Thread(target=m.run, daemon=True)
+    mt.start()
+    sink = Sub("*", learner_port, bind=True)
+    pub = Pub("127.0.0.1", worker_port, bind=False)
+    frame = encode(Protocol.RolloutBatch, payload)
+    send_stop = threading.Event()
+    sent = [0]
+
+    def produce() -> None:
+        while not send_stop.is_set():
+            pub.send_raw(frame)
+            sent[0] += 1
+
+    pt = threading.Thread(target=produce, daemon=True)
+    pt.start()
+    try:
+        # Warm-up: wait for the first forwarded frame (slow-joiner windows on
+        # both PUB hops) before opening the timed window.
+        deadline = time.time() + 30
+        primed = False
+        while time.time() < deadline and not primed:
+            primed = sink.recv_raw(timeout_ms=100) is not None
+        if not primed:
+            raise RuntimeError(f"relay ({mode}) never forwarded a frame")
+        n = nbytes = 0
+        t0 = time.perf_counter()
+        while (dt := time.perf_counter() - t0) < duration:
+            got = sink.recv_raw(timeout_ms=20)
+            if got is not None:
+                n += 1
+                nbytes += len(got[1][0]) + len(got[1][1])
+    finally:
+        send_stop.set()
+        pt.join(timeout=5)
+        stop.set()
+        mt.join(timeout=10)
+        sink.close()
+        pub.close()
+    n_envs = len(payload["id"])
+    return dict(
+        mode=mode,
+        frames_per_s=round(n / dt, 1),
+        env_steps_per_s=round(n * n_envs / dt, 1),
+        wire_mb_per_s=round(nbytes / dt / 1e6, 2),
+        frames_forwarded=n,
+        frames_sent=sent[0],
+        manager_dropped=m.n_dropped,
+        seconds=round(dt, 2),
+    )
+
+
+def ingest_row(mode: str, n_ticks: int, payload: dict) -> dict:
+    """Env-steps/s through the REAL LearnerStorage ingest + flush (no
+    sockets — frame decode costs the same in both modes and is measured by
+    the relay row): push_tick + put_many (raw) vs split_rollout_batch +
+    per-step push + per-window put (decode). The ReplayStore always accepts,
+    so the row measures the assembler/store path, not backpressure."""
+    from tpu_rl.config import Config
+    from tpu_rl.data.assembler import RolloutAssembler
+    from tpu_rl.data.layout import BatchLayout
+    from tpu_rl.data.shm_ring import ReplayStore, alloc_handles
+    from tpu_rl.runtime.protocol import Protocol
+    from tpu_rl.runtime.storage import LearnerStorage
+
+    cfg = Config.from_dict(
+        dict(algo="SAC", obs_shape=(4,), action_space=2, hidden_size=64,
+             buffer_size=4096, relay_mode=mode, rollout_lag_sec=1e9)
+    )
+    layout = BatchLayout.from_config(cfg)
+    handles = alloc_handles(layout, cfg.buffer_size)
+    store = ReplayStore(handles, layout)
+    st = LearnerStorage(cfg, handles, 0)
+    asm = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
+    n_envs = len(payload["id"])
+    # warm-up pass (allocators, first window emit)
+    for _ in range(layout.seq_len):
+        st._ingest(Protocol.RolloutBatch, payload, asm)
+    st._flush(asm, store)
+    t0 = time.perf_counter()
+    for _ in range(n_ticks):
+        st._ingest(Protocol.RolloutBatch, payload, asm)
+        st._flush(asm, store)
+    dt = time.perf_counter() - t0
+    return dict(
+        mode=mode,
+        ticks_per_s=round(n_ticks / dt, 1),
+        env_steps_per_s=round(n_ticks * n_envs / dt, 1),
+        windows=st.n_windows,
+        seconds=round(dt, 2),
+    )
+
+
+def run_relay_compare(
+    duration: float | None = None,
+    ingest_ticks: int | None = None,
+    n_envs: int = 32,
+    base_port: int = 29940,
+    out_path: str | None = None,
+) -> dict:
+    """Raw vs decode fan-in, both legs of ISSUE 3's A/B: the Manager relay
+    (frames/s, real ZMQ) and the storage ingest (env-steps/s, real
+    assembler + shm store) at the 32-env reference tick shape. Acceptance:
+    raw >= 3x decode frames/s through the manager on CPU.
+
+    ``TPU_RL_BENCH_RELAY_LIGHT=1`` is the CI smoke shape: short windows, no
+    result file (committed numbers never flap with CI load), and a hard
+    assert that raw sustains at least decode's frame rate."""
+    light = bool(os.environ.get("TPU_RL_BENCH_RELAY_LIGHT"))
+    if duration is None:
+        duration = 1.0 if light else 4.0
+    if ingest_ticks is None:
+        ingest_ticks = 300 if light else 3000
+    payload = _relay_tick_payload(n_envs)
+    rows = []
+    for i, mode in enumerate(("decode", "raw")):
+        row = dict(
+            relay=relay_forward_row(mode, base_port + 10 * i, duration, payload),
+            ingest=ingest_row(mode, ingest_ticks, payload),
+        )
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    dec, raw = rows
+    fps_speedup = (
+        raw["relay"]["frames_per_s"] / dec["relay"]["frames_per_s"]
+        if dec["relay"]["frames_per_s"] else None
+    )
+    ingest_speedup = (
+        raw["ingest"]["env_steps_per_s"] / dec["ingest"]["env_steps_per_s"]
+        if dec["ingest"]["env_steps_per_s"] else None
+    )
+    result = {
+        "metric": "manager relay frames/s, raw vs decode",
+        "n_envs": n_envs,
+        "relay_frames_speedup": round(fps_speedup, 2) if fps_speedup else None,
+        "ingest_env_steps_speedup": (
+            round(ingest_speedup, 2) if ingest_speedup else None
+        ),
+        "raw_frames_per_s": raw["relay"]["frames_per_s"],
+        "decode_frames_per_s": dec["relay"]["frames_per_s"],
+        "raw_ingest_env_steps_per_s": raw["ingest"]["env_steps_per_s"],
+        "decode_ingest_env_steps_per_s": dec["ingest"]["env_steps_per_s"],
+        "light": light,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    if light:
+        # CI smoke contract: direction only, never a committed number.
+        assert raw["relay"]["frames_per_s"] >= dec["relay"]["frames_per_s"], (
+            f"raw relay slower than decode: {result}"
+        )
+        return result
+    if out_path is None:
+        on_cpu = jax.devices()[0].platform == "cpu"
+        out_path = "bench_relay.cpu.json" if on_cpu else "bench_relay.json"
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
 def _accelerator_reachable(timeout_s: float = 120.0) -> str | None:
     from tpu_rl.utils.platform import accelerator_reachable
 
@@ -792,6 +994,13 @@ def last_good_onchip(path: str | None = None) -> dict | None:
 
 
 if __name__ == "__main__":
+    if os.environ.get("TPU_RL_BENCH_RELAY"):
+        # Relay/ingest A/B mode: zero-copy raw fan-in vs the decode baseline
+        # through the real Manager + LearnerStorage (host-side; no
+        # accelerator involved). TPU_RL_BENCH_RELAY_LIGHT=1 is the `make ci`
+        # smoke shape. See also examples/bench_relay.py for the CLI.
+        print(json.dumps(run_relay_compare()))
+        sys.exit(0)
     if os.environ.get("TPU_RL_BENCH_ACT"):
         # Acting A/B mode: local jitted acting vs the centralized inference
         # service (SEED-style remote acting) with real DEALER/ROUTER

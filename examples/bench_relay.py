@@ -1,0 +1,58 @@
+"""Rollout fan-in A/B: zero-copy raw relay vs decode baseline.
+
+The harness lives in ``bench.run_relay_compare`` (shared with the
+``TPU_RL_BENCH_RELAY=1 python bench.py`` mode); this wrapper adds the CLI.
+Both legs of the ISSUE-3 A/B run per mode:
+
+- relay: a producer PUB floods pre-encoded 32-env RolloutBatch frames at a
+  REAL Manager over real ZMQ; a sink SUB (bound where storage binds) counts
+  forwarded frames/s. Raw mode peeks the header and forwards the wire parts
+  verbatim; decode mode pays the full decode + re-encode per frame.
+- ingest: the REAL LearnerStorage path, no sockets — columnar
+  ``push_tick`` + ``put_many`` (raw) vs ``split_rollout_batch`` + per-step
+  ``push`` + per-window ``put`` (decode), in env-steps/s.
+
+Host-side benchmark (manager and storage never touch the accelerator):
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/bench_relay.py \
+      [--duration 4.0] [--ticks 3000] [--envs 32] [--port 29940] \
+      [--out bench_relay.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--duration", type=float, default=None,
+                   help="timed relay window per mode, seconds (default 4)")
+    p.add_argument("--ticks", type=int, default=None,
+                   help="timed ingest ticks per mode (default 3000)")
+    p.add_argument("--envs", type=int, default=32,
+                   help="envs per tick frame (default 32, the reference "
+                        "tick shape the acceptance bar is specified at)")
+    p.add_argument("--port", type=int, default=29940)
+    p.add_argument("--out", default=None,
+                   help="result JSON path (default bench_relay[.cpu].json)")
+    args = p.parse_args()
+
+    from bench import run_relay_compare
+
+    result = run_relay_compare(
+        duration=args.duration,
+        ingest_ticks=args.ticks,
+        n_envs=args.envs,
+        base_port=args.port,
+        out_path=args.out,
+    )
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()

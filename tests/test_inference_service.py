@@ -17,7 +17,7 @@ from tests.conftest import small_config
 from tpu_rl.models.families import build_family
 from tpu_rl.runtime.inference_service import InferenceClient, InferenceService
 from tpu_rl.runtime.manager import Manager, STAT_WINDOW
-from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.protocol import Protocol, encode
 from tpu_rl.runtime.storage import LearnerStorage, STAT_SLOTS
 from tpu_rl.runtime.transport import Dealer, Router
 
@@ -421,14 +421,16 @@ class TestStatPlumbing:
         m = Manager(small_config(), 0, "127.0.0.1", 0)
         pub = FakePub()
         for i in range(STAT_WINDOW):
+            # Default relay_mode is "raw": the manager receives wire parts
+            # and decodes only Stat frames itself.
             m._ingest(
                 Protocol.Stat,
-                {
+                encode(Protocol.Stat, {
                     "rew": float(i),
                     "n_model_loads": 5,
                     "n_rejected": 2,
                     "wid": i % 2,
-                },
+                }),
                 pub,
             )
         assert len(pub.sent) == 1
@@ -443,21 +445,23 @@ class TestStatPlumbing:
         m = Manager(small_config(), 0, "127.0.0.1", 0)
         pub = FakePub()
         for i in range(STAT_WINDOW):
-            m._ingest(Protocol.Stat, float(i), pub)
+            m._ingest(Protocol.Stat, encode(Protocol.Stat, float(i)), pub)
         assert len(pub.sent) == 1
         assert pub.sent[0][1]["model_loads"] == 0
 
     def test_storage_mailbox_health_slots(self):
-        assert STAT_SLOTS == 5
+        assert STAT_SLOTS == 7
         cfg = small_config()
         sa = np.zeros(STAT_SLOTS, np.float32)
         storage = LearnerStorage(cfg, handles=None, learner_port=0,
                                  stat_array=sa)
         storage._relay_stat(
-            {"mean": 7.5, "n": 50, "rejected": 3, "model_loads": 12}
+            {"mean": 7.5, "n": 50, "rejected": 3, "model_loads": 12,
+             "relay_dropped": 2, "forward_bytes": 4096.0}
         )
         assert sa[0] == 50 and sa[1] == 7.5 and sa[2] == 1.0
         assert sa[3] == 3.0 and sa[4] == 12.0
+        assert sa[5] == 2.0 and sa[6] == 4096.0
 
     def test_storage_mailbox_tolerates_legacy_3_slot_array(self):
         cfg = small_config()

@@ -1,0 +1,197 @@
+"""Zero-copy relay tests (ISSUE 3 tentpole): ``protocol.peek`` header
+validation, byte-identical forwarding through a real Manager over real ZMQ,
+corrupt/foreign-frame rejection without crashing, and one-frame drop
+granularity. The full CRC+decode runs only at the storage edge —
+``test_peek_skips_crc`` pins exactly that division of labor."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.runtime.manager import Manager
+from tpu_rl.runtime.protocol import (
+    _HEADER,
+    _MAGIC,
+    _MAX_RAW,
+    _VERSION,
+    Codec,
+    Protocol,
+    decode,
+    encode,
+    peek,
+)
+from tpu_rl.runtime.transport import Pub, Sub
+
+
+def _frame(payload={"x": 1}, proto=Protocol.RolloutBatch):
+    return encode(proto, payload)
+
+
+class TestPeek:
+    def test_valid_frame_returns_proto(self):
+        parts = _frame({"obs": np.arange(64, dtype=np.float32)})
+        assert peek(parts) == Protocol.RolloutBatch
+        assert peek(_frame(1.5, Protocol.Stat)) == Protocol.Stat
+
+    @pytest.mark.parametrize(
+        "parts",
+        [
+            [b"\x01"],  # missing body frame
+            [b"", b"x"],  # empty proto frame
+            [b"\x01\x01", b"x"],  # 2-byte proto frame
+            [b"\x01", b"x", b"y"],  # extra part
+        ],
+    )
+    def test_malformed_multipart_rejected(self, parts):
+        with pytest.raises(ValueError):
+            peek(parts)
+
+    def test_unknown_proto_byte_rejected(self):
+        _, body = _frame()
+        with pytest.raises(ValueError):
+            peek([bytes([250]), body])
+
+    def test_short_frame_rejected(self):
+        with pytest.raises(ValueError):
+            peek([b"\x01", b"tiny"])
+
+    def test_bad_magic_and_version_rejected(self):
+        pb, body = _frame()
+        _, ver, codec, raw, crc = _HEADER.unpack_from(body)
+        bad_magic = _HEADER.pack(0xDEAD, ver, codec, raw, crc) + body[_HEADER.size:]
+        with pytest.raises(ValueError):
+            peek([pb, bad_magic])
+        bad_ver = _HEADER.pack(_MAGIC, ver + 1, codec, raw, crc) + body[_HEADER.size:]
+        with pytest.raises(ValueError):
+            peek([pb, bad_ver])
+
+    def test_oversized_declared_raw_rejected(self):
+        # A frame CLAIMING a >1 GiB decompressed size must be rejected at the
+        # relay, before any hop allocates for it (decompression-bomb guard).
+        body = _HEADER.pack(_MAGIC, _VERSION, Codec.ZLIB, _MAX_RAW + 1, 0) + b"zz"
+        with pytest.raises(ValueError):
+            peek([b"\x01", body])
+
+    def test_raw_codec_body_size_mismatch_rejected(self):
+        body = _HEADER.pack(_MAGIC, _VERSION, Codec.RAW, 100, 0) + b"short"
+        with pytest.raises(ValueError):
+            peek([b"\x01", body])
+
+    def test_unknown_codec_rejected(self):
+        body = _HEADER.pack(_MAGIC, _VERSION, 99, 4, 0) + b"bbbb"
+        with pytest.raises(ValueError):
+            peek([b"\x01", body])
+
+    def test_peek_skips_crc(self):
+        # Flip a body byte: peek (header-only, no CRC pass) still accepts —
+        # the relay's contract — while the storage edge's full decode rejects.
+        pb, body = _frame({"obs": np.arange(64, dtype=np.float32)})
+        corrupt = body[:-1] + bytes([body[-1] ^ 0xFF])
+        assert peek([pb, corrupt]) == Protocol.RolloutBatch
+        with pytest.raises(ValueError):
+            decode([pb, corrupt])
+
+
+@pytest.mark.timeout(60)
+def test_send_raw_recv_raw_loopback_byte_identical():
+    """Pub.send_raw -> Sub.recv_raw over real ZMQ: the received wire parts
+    are byte-for-byte the sent ones (the property the whole relay rests on)."""
+    port = 29610
+    sub = Sub("*", port, bind=True)
+    pub = Pub("127.0.0.1", port, bind=False)
+    sent = _frame({"obs": np.arange(128, dtype=np.float32), "tag": "loop"})
+    try:
+        got = None
+        deadline = time.time() + 30
+        while time.time() < deadline and got is None:
+            pub.send_raw(sent)  # resend past the slow-joiner window
+            got = sub.recv_raw(timeout_ms=200)
+        assert got is not None, "loopback frame never arrived"
+        proto, parts = got
+        assert proto == Protocol.RolloutBatch
+        assert parts[0] == sent[0] and parts[1] == sent[1]
+    finally:
+        sub.close()
+        pub.close()
+
+
+@pytest.mark.timeout(120)
+def test_manager_raw_relay_forwards_byte_identical_and_survives_garbage():
+    """A real Manager in raw mode between a real producer PUB and sink SUB:
+    forwarded RolloutBatch frames arrive byte-identical to what the producer
+    sent; garbage and corrupt-header frames are rejected at peek (counted in
+    the SUB's n_rejected) without crashing the relay, which keeps forwarding
+    valid frames afterwards."""
+    worker_port, learner_port = 29620, 29621
+    cfg = small_config(relay_mode="raw")
+    stop = threading.Event()
+    m = Manager(cfg, worker_port, "127.0.0.1", learner_port, stop_event=stop)
+    t = threading.Thread(target=m.run, daemon=True)
+    t.start()
+    sink = Sub("*", learner_port, bind=True)
+    pub = Pub("127.0.0.1", worker_port, bind=False)
+    sent = _frame({"obs": np.arange(32, dtype=np.float32), "phase": "pre"})
+    garbage = [
+        [b"\xfa", b"not a frame"],  # unknown proto byte
+        [b"junk"],  # wrong part count
+        [sent[0], b"tiny"],  # short frame
+    ]
+    try:
+        got = None
+        deadline = time.time() + 60
+        while time.time() < deadline and got is None:
+            pub.send_raw(sent)
+            got = sink.recv_raw(timeout_ms=200)
+        assert got is not None, "relay never forwarded the first frame"
+        assert got[1][0] == sent[0] and got[1][1] == sent[1]
+
+        # Corrupt frames: rejected at the relay's peek, relay stays alive.
+        for g in garbage:
+            pub.send_raw(g)
+        sent2 = _frame({"obs": np.arange(32, dtype=np.float32), "phase": "post"})
+        got2 = None
+        deadline = time.time() + 60
+        while time.time() < deadline and got2 is None:
+            pub.send_raw(sent2)
+            got2 = sink.recv_raw(timeout_ms=200)
+            if got2 is not None and got2[1][1] == sent[1]:
+                got2 = None  # stragglers of the first frame
+        assert got2 is not None, "relay died after garbage frames"
+        assert got2[1][0] == sent2[0] and got2[1][1] == sent2[1]
+        assert decode(got2[1])[1]["phase"] == "post"
+        assert m._sub is not None and m._sub.n_rejected >= len(garbage)
+        assert t.is_alive()
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        sink.close()
+        pub.close()
+    assert not t.is_alive()
+
+
+def test_drop_oldest_granularity_is_one_frame():
+    """Eviction from the bounded relay queue sheds exactly one frame per
+    arrival past capacity — never a flush of the deque."""
+    cfg = small_config(relay_mode="raw")
+    m = Manager(cfg, 0, "127.0.0.1", 0)
+
+    class _NullPub:
+        def send_raw(self, parts):
+            pass
+
+        def send(self, proto, payload):
+            pass
+
+    pub = _NullPub()
+    cap = m.queue.maxlen
+    frames = [encode(Protocol.Rollout, {"i": i}) for i in range(cap + 3)]
+    for fr in frames:
+        m._ingest(Protocol.Rollout, fr, pub)
+    assert len(m.queue) == cap
+    assert m.n_dropped == 3
+    # survivors are the newest cap frames, oldest-first
+    assert decode(m.queue[0])[1]["i"] == 3
+    assert decode(m.queue[-1])[1]["i"] == cap + 2

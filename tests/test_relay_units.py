@@ -3,13 +3,14 @@ test covers the wiring; these pin the behaviors: drop-oldest backpressure,
 50-game stat windowing, stat mailbox relay, store-full requeue)."""
 
 import numpy as np
+import pytest
 
 from tests.conftest import small_config
 from tpu_rl.data.assembler import RolloutAssembler
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import alloc_handles, OnPolicyStore
 from tpu_rl.runtime.manager import Manager, RELAY_QUEUE_MAX, STAT_WINDOW
-from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.protocol import Protocol, decode, encode
 from tpu_rl.runtime.storage import LearnerStorage, STAT_SLOTS
 from tpu_rl.types import BATCH_FIELDS
 
@@ -17,37 +18,52 @@ from tpu_rl.types import BATCH_FIELDS
 class FakePub:
     def __init__(self):
         self.sent = []
+        self.sent_raw = []
 
     def send(self, proto, payload):
         self.sent.append((proto, payload))
 
+    def send_raw(self, parts):
+        self.sent_raw.append(parts)
 
-def _manager(cfg=None):
-    cfg = cfg or small_config()
+
+def _manager(cfg=None, **kw):
+    cfg = cfg or small_config(**kw)
     return Manager(cfg, 0, "127.0.0.1", 0)
 
 
+def _ingest_frame(m, proto, payload, pub):
+    """Feed one frame through _ingest in whatever form the manager's mode
+    expects: opaque wire parts (raw) or the decoded payload (decode)."""
+    m._ingest(proto, encode(proto, payload) if m.raw else payload, pub)
+
+
 class TestManager:
-    def test_rollout_queue_drops_oldest(self):
-        m = _manager()
+    @pytest.mark.parametrize("relay_mode", ["raw", "decode"])
+    def test_rollout_queue_drops_oldest(self, relay_mode):
+        m = _manager(relay_mode=relay_mode)
         pub = FakePub()
         for i in range(RELAY_QUEUE_MAX + 10):
             proto = (
                 Protocol.RolloutBatch if i % 2 else Protocol.Rollout
             )  # both frame kinds share the relay queue
-            m._ingest(proto, {"i": i}, pub)
+            _ingest_frame(m, proto, {"i": i}, pub)
         assert len(m.queue) == RELAY_QUEUE_MAX
-        # the 10 oldest were shed (stale rollouts are least on-policy)
-        proto0, payload0 = m.queue[0]
+        # the 10 shed frames are counted (silent-drop fix), one per eviction
+        assert m.n_dropped == 10
+        # the 10 oldest were shed (stale rollouts are least on-policy); the
+        # queue holds fully-encoded wire parts in BOTH modes
+        proto0, payload0 = decode(m.queue[0])
         assert payload0["i"] == 10 and proto0 == Protocol.Rollout
-        # frames relay with their ORIGINAL protocol byte (never re-encoded)
-        assert m.queue[1][0] == Protocol.RolloutBatch
+        # frames relay with their ORIGINAL protocol byte
+        assert decode(m.queue[1])[0] == Protocol.RolloutBatch
 
-    def test_stat_window_publishes_mean_every_50(self):
-        m = _manager()
+    @pytest.mark.parametrize("relay_mode", ["raw", "decode"])
+    def test_stat_window_publishes_mean_every_50(self, relay_mode):
+        m = _manager(relay_mode=relay_mode)
         pub = FakePub()
         for i in range(STAT_WINDOW * 2):
-            m._ingest(Protocol.Stat, float(i), pub)
+            _ingest_frame(m, Protocol.Stat, float(i), pub)
         assert len(pub.sent) == 2
         proto, payload = pub.sent[0]
         assert proto == Protocol.Stat
@@ -55,6 +71,17 @@ class TestManager:
         assert payload["mean"] == np.mean(np.arange(50.0))
         # second window is the NEWEST 50 (sliding deque)
         assert pub.sent[1][1]["mean"] == np.mean(np.arange(50.0, 100.0))
+        # windowed publish carries the relay health counters (ISSUE 3)
+        assert payload["relay_dropped"] == 0
+        assert "forward_bytes" in payload
+
+    def test_raw_mode_corrupt_stat_body_counted_not_crashed(self):
+        m = _manager(relay_mode="raw")
+        pub = FakePub()
+        proto_b, frame = encode(Protocol.Stat, 1.0)
+        corrupt = frame[:-1] + bytes([frame[-1] ^ 0xFF])  # CRC mismatch
+        m._ingest(Protocol.Stat, [proto_b, corrupt], pub)
+        assert m.n_stat_rejected == 1 and m.n_stats == 0
 
 
 def _mk_window(layout, tag):

@@ -53,13 +53,19 @@ def _cluster_cfg(tmp_path, **kw):
 
 
 @pytest.mark.timeout(300)
-def test_local_cluster_end_to_end(tmp_path):
+@pytest.mark.parametrize("relay_mode", ["raw", "decode"])
+def test_local_cluster_end_to_end(tmp_path, relay_mode):
     """Spawn the whole local cluster; the learner must complete updates fed
-    ONLY by worker rollouts over ZMQ, then checkpoint."""
+    ONLY by worker rollouts over ZMQ, then checkpoint. Runs in both relay
+    modes: the zero-copy raw fan-in (manager forwards opaque wire parts,
+    storage ingests whole ticks via push_tick) and the decode A/B baseline
+    must be indistinguishable end-to-end (bit-level window equivalence is
+    pinned separately in test_push_tick_equivalence.py)."""
     from tpu_rl.runtime.runner import local_cluster
 
-    cfg = _cluster_cfg(tmp_path)
-    sup = local_cluster(cfg, _machines(29100), max_updates=6)
+    cfg = _cluster_cfg(tmp_path, relay_mode=relay_mode)
+    base = 29100 if relay_mode == "raw" else 28100
+    sup = local_cluster(cfg, _machines(base), max_updates=6)
     try:
         learner = next(c for c in sup.children if c.name == "learner")
         deadline = time.time() + 240

@@ -247,6 +247,17 @@ class Config:
     # RolloutAssembler idle-trajectory drop window, seconds
     # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
     rollout_lag_sec: float = 0.5
+    # Rollout fan-in relay path (manager + storage ingest). "raw": the
+    # manager routes Rollout/RolloutBatch frames on the proto byte alone
+    # (protocol.peek — header/size validation only, no CRC/LZ4/unpack) and
+    # forwards the received wire bytes verbatim, O(1) per frame; storage —
+    # the only payload consumer — runs the single full CRC+decode and
+    # ingests each tick columnar-wise (RolloutAssembler.push_tick).
+    # "decode": the pre-zero-copy A/B baseline — the manager fully decodes
+    # and re-encodes every frame and storage shreds ticks into per-step
+    # dicts (split_rollout_batch + per-step push). Same assembled windows
+    # bit-for-bit either way (tests/test_push_tick_equivalence.py).
+    relay_mode: str = "raw"
     # Acting placement (SEED RL / Podracer-Sebulba): "local" — each worker
     # runs its own jitted policy forward on CPU (reference semantics);
     # "remote" — workers ship observations to the centralized inference
@@ -313,6 +324,7 @@ class Config:
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs
         assert self.act_mode in ("local", "remote"), self.act_mode
+        assert self.relay_mode in ("raw", "decode"), self.relay_mode
         assert self.inference_batch >= 1, self.inference_batch
         assert self.inference_flush_us >= 0, self.inference_flush_us
         assert self.inference_timeout_ms > 0, self.inference_timeout_ms

@@ -15,7 +15,18 @@ synchronous, transport-agnostic state machine (the reference couples it to an
   the seam so losses mask the fake time adjacency
   (reference ``rollout_assembler.py:61-67``).
 
-Divergences (deliberate, documented):
+Columnar storage ingest (ISSUE 3): trajectories accumulate into preallocated
+``(seq_len, width)`` float32 buffers, one row write per field per step —
+no per-step dict objects, no ``np.asarray``+``np.stack`` at window emit (the
+window IS the filled buffer). :meth:`RolloutAssembler.push_tick` consumes a
+whole worker tick (``Protocol.RolloutBatch`` payload) directly: one clock
+read + one stale scan per tick, row views per env, zero intermediate
+per-step dicts. :func:`split_rollout_batch` + per-step :meth:`push` remain
+as the reference implementation;
+``tests/test_push_tick_equivalence.py`` pins bit-identical windows (splice
+seams, stale drops included) between the two paths.
+
+Divergences from the reference (deliberate, documented):
 
 - staleness is measured from the trajectory's **last push**, not its creation
   time — the reference drops a trajectory 0.5 s after *creation* even while
@@ -31,7 +42,6 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -39,26 +49,29 @@ from tpu_rl.data.layout import BatchLayout
 from tpu_rl.types import BATCH_FIELDS
 
 
-@dataclass
 class Trajectory:
-    """Per-episode step accumulator (reference ``Trajectory2``,
-    ``/root/reference/buffers/trajectory.py:20-39``), with a last-activity
-    timestamp instead of a creation timestamp."""
+    """Per-episode columnar accumulator (successor of the reference's
+    ``Trajectory2`` step list, ``/root/reference/buffers/trajectory.py:20-39``):
+    preallocated ``(seq_len, width)`` float32 buffers filled row-by-row, with
+    a last-activity timestamp instead of a creation timestamp. When the last
+    row fills, ``cols`` is emitted as the window itself — no stacking pass."""
 
-    steps: list[dict] = field(default_factory=list)
-    last_push: float = 0.0
+    __slots__ = ("cols", "n", "last_push")
 
-    def put(self, step: dict, now: float) -> None:
-        self.steps.append(step)
-        self.last_push = now
+    def __init__(self, cols: dict[str, np.ndarray], last_push: float = 0.0):
+        self.cols = cols
+        self.n = 0
+        self.last_push = last_push
 
     def __len__(self) -> int:
-        return len(self.steps)
+        return self.n
 
 
 def stack_window(steps: list[dict]) -> dict[str, np.ndarray]:
     """steps (list of per-step field dicts) -> dict of (seq, width) arrays
-    (reference ``make_as_array``, ``rollout_assembler.py:9-22``)."""
+    (reference ``make_as_array``, ``rollout_assembler.py:9-22``). Reference
+    implementation only — the assembler writes rows straight into each
+    trajectory's preallocated buffers instead of stacking at emit."""
     return {
         k: np.stack([np.asarray(s[k], np.float32) for s in steps])
         for k in BATCH_FIELDS
@@ -72,7 +85,10 @@ def split_rollout_batch(payload: dict) -> list[dict]:
     Inverse of the worker's per-tick stacking (``runtime/worker.py``,
     ``Protocol.RolloutBatch``): every batch field is an ``(n_envs, width)``
     array, ``id`` is a list of per-env episode ids, ``done`` an ``(n_envs,)``
-    array. Row views (no copies) — ``stack_window`` copies when it stacks."""
+    array. Row views (no copies). Reference implementation for the ingest
+    path — the hot path is :meth:`RolloutAssembler.push_tick`, which skips
+    these intermediate dicts entirely (equivalence pinned by
+    ``tests/test_push_tick_equivalence.py``)."""
     ids = payload["id"]
     done = np.asarray(payload["done"])
     return [
@@ -108,6 +124,15 @@ class RolloutAssembler:
         self.n_dropped_stale = 0
         self.n_spliced = 0
 
+    def _new_traj(self, now: float) -> Trajectory:
+        return Trajectory(
+            {
+                f: np.empty((self.seq_len, self.layout.width(f)), np.float32)
+                for f in BATCH_FIELDS
+            },
+            last_push=now,
+        )
+
     # ------------------------------------------------------------------ push
     def push(self, step: dict) -> int:
         """Feed one env step ``{**BATCH_FIELDS, "id": str, "done": bool}``.
@@ -119,39 +144,82 @@ class RolloutAssembler:
             self.layout.validate_step(step)
 
         self._drop_stale(now)
-
-        tj = self.active.get(eid)
-        if tj is None:
-            tj = self._splice_or_new(step, now)
-            self.active[eid] = tj
-        tj.put(step, now)
+        tj, seam = self._traj_for(eid, now)
+        r = tj.n
+        for f in BATCH_FIELDS:
+            tj.cols[f][r] = step[f]  # row write: casts to f32 in place
+        if seam:
+            # The seam is a fake time adjacency: force the episode-first flag
+            # so GAE/V-trace/value bootstraps are masked across it.
+            tj.cols["is_fir"][r] = 1.0
+        tj.n += 1
+        tj.last_push = now
         self.n_steps += 1
         # Maintain the lower bound on min(last_push) used by _drop_stale.
         if now < self._oldest_push:
             self._oldest_push = now
+        return self._close_row(eid, tj, done)
 
+    def push_tick(self, payload: dict) -> int:
+        """Feed one whole worker tick (``Protocol.RolloutBatch`` payload:
+        each batch field ``(n_envs, width)``, ``id`` a list of episode ids,
+        ``done`` ``(n_envs,)``) columnar-wise: one clock read and one stale
+        scan for the tick, then one row write per field per env directly
+        into each episode's preallocated window buffer — no per-step dict
+        objects (the ``split_rollout_batch`` + per-step :meth:`push` pair is
+        the reference path this replaces on the storage hot loop). Returns
+        the number of windows newly ready."""
+        ids = payload["id"]
+        done = np.asarray(payload["done"])
+        now = self.clock()
+        if self.validate:
+            self.layout.validate_tick(payload, len(ids))
+        self._drop_stale(now)
         emitted = 0
-        if len(tj) >= self.seq_len:
-            self.ready.append(stack_window(self.active.pop(eid).steps))
-            self.n_windows += 1
-            emitted = 1
-        elif done:
-            # Episode over, window short: park the remnant for splicing.
-            self.parked[eid] = self.active.pop(eid)
+        for i, eid in enumerate(ids):
+            tj, seam = self._traj_for(eid, now)
+            r = tj.n
+            for f in BATCH_FIELDS:
+                tj.cols[f][r] = payload[f][i]  # row view -> buffer row
+            if seam:
+                tj.cols["is_fir"][r] = 1.0
+            tj.n += 1
+            tj.last_push = now
+            emitted += self._close_row(eid, tj, bool(done[i]))
+        self.n_steps += len(ids)
+        if now < self._oldest_push:
+            self._oldest_push = now
         return emitted
 
-    def _splice_or_new(self, step: dict, now: float) -> Trajectory:
+    def _traj_for(self, eid: str, now: float) -> tuple[Trajectory, bool]:
+        """Active trajectory for ``eid``; a new episode splices onto the
+        shortest parked remnant (returning seam=True for the is_fir mark)
+        or starts fresh buffers."""
+        tj = self.active.get(eid)
+        if tj is not None:
+            return tj, False
         if self.parked:
-            # Splice onto the shortest parked remnant so remnants drain fastest
-            # (reference heappop-by-length, ``rollout_assembler.py:61-65``).
-            eid = min(self.parked, key=lambda k: len(self.parked[k]))
-            tj = self.parked.pop(eid)
-            # The seam is a fake time adjacency: force the episode-first flag
-            # so GAE/V-trace/value bootstraps are masked across it.
-            step["is_fir"] = np.ones_like(np.asarray(step["is_fir"], np.float32))
+            # Splice onto the shortest parked remnant so remnants drain
+            # fastest (reference heappop-by-length, rollout_assembler.py:61-65).
+            k = min(self.parked, key=lambda x: len(self.parked[x]))
+            tj = self.parked.pop(k)
             self.n_spliced += 1
-            return tj
-        return Trajectory(last_push=now)
+            self.active[eid] = tj
+            return tj, True
+        tj = self._new_traj(now)
+        self.active[eid] = tj
+        return tj, False
+
+    def _close_row(self, eid: str, tj: Trajectory, done: bool) -> int:
+        if tj.n >= self.seq_len:
+            # The filled buffer IS the window — ownership transfers out.
+            self.ready.append(self.active.pop(eid).cols)
+            self.n_windows += 1
+            return 1
+        if done:
+            # Episode over, window short: park the remnant for splicing.
+            self.parked[eid] = self.active.pop(eid)
+        return 0
 
     def _drop_stale(self, now: float) -> None:
         # Skip the O(episodes) scan until the oldest trajectory could possibly
@@ -176,6 +244,15 @@ class RolloutAssembler:
     def pop(self) -> dict | None:
         """Next ready window as a dict of (seq, width) arrays, or None."""
         return self.ready.popleft() if self.ready else None
+
+    def pop_many(self, max_windows: int | None = None) -> list[dict]:
+        """Drain up to ``max_windows`` ready windows (all, when None) — the
+        multi-window companion of :meth:`pop` feeding the stores'
+        ``put_many`` burst writes."""
+        n = len(self.ready) if max_windows is None else min(
+            max_windows, len(self.ready)
+        )
+        return [self.ready.popleft() for _ in range(n)]
 
     def __len__(self) -> int:
         return len(self.ready)

@@ -86,3 +86,20 @@ class BatchLayout:
                     f"step field {f!r}: expected shape ({self.width(f)},), "
                     f"got {arr.shape}"
                 )
+
+    def validate_tick(self, payload: dict, n_envs: int) -> None:
+        """Assert a whole-tick RolloutBatch payload matches this layout:
+        every batch field ``(n_envs, width)`` — the columnar counterpart of
+        :meth:`validate_step` for ``RolloutAssembler.push_tick``."""
+        for f in BATCH_FIELDS:
+            arr = np.asarray(payload[f])
+            if arr.shape != (n_envs, self.width(f)):
+                raise ValueError(
+                    f"tick field {f!r}: expected shape "
+                    f"({n_envs}, {self.width(f)}), got {arr.shape}"
+                )
+        done = np.asarray(payload["done"])
+        if done.shape != (n_envs,):
+            raise ValueError(
+                f"tick done: expected shape ({n_envs},), got {done.shape}"
+            )

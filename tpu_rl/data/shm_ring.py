@@ -126,6 +126,39 @@ class OnPolicyStore(_StoreBase):
             # generation (this is the race the reference ignores).
         return False
 
+    def put_many(self, windows: list[dict]) -> int:
+        """Write a burst of trajectory windows with one contiguous slice
+        write per field per generation (vs one slot write per window via
+        :meth:`put`). Returns how many were accepted — the tail past a full
+        generation is rejected, preserving window order, so callers requeue
+        ``windows[accepted:]`` exactly as they would a single rejected put."""
+        if not windows:
+            return 0
+        h = self.h
+        written = 0
+        while written < len(windows):
+            for _ in range(self.MAX_PUT_RETRIES):
+                with h.lock:
+                    gen, slot = h.gen.value, h.count.value
+                    if slot >= self.capacity:
+                        return written
+                k = min(len(windows) - written, self.capacity - slot)
+                chunk = windows[written : written + k]
+                for f in BATCH_FIELDS:
+                    # One slice write per field: numpy stacks the k windows'
+                    # (seq, width) arrays straight into the shm view.
+                    self.views[f][slot : slot + k] = [w[f] for w in chunk]
+                with h.lock:
+                    if h.gen.value == gen:
+                        h.count.value = slot + k
+                        written += k
+                        break
+                # Consume intervened mid-burst: re-write into the new
+                # generation (same retry contract as put()).
+            else:
+                return written
+        return written
+
     # ---------------------------------------------------------------- reader
     @property
     def size(self) -> int:
@@ -165,6 +198,29 @@ class ReplayStore(_StoreBase):
         with h.lock:
             h.count.value = total + 1
         return True
+
+    def put_many(self, windows: list[dict]) -> int:
+        """Ring-write a burst of windows with one fancy-indexed write per
+        field per chunk. Chunked to ``capacity`` so the slot set within a
+        write stays duplicate-free; across chunks the ring overwrite order
+        matches sequential :meth:`put` calls. Always accepts everything
+        (the ring never rejects), returning ``len(windows)``."""
+        h = self.h
+        done = 0
+        while done < len(windows):
+            chunk = windows[done : done + self.capacity]
+            k = len(chunk)
+            with h.lock:
+                total = h.count.value
+            slots = (total + np.arange(k)) % self.capacity
+            self.versions[slots] += 1  # odd: writes in progress
+            for f in BATCH_FIELDS:
+                self.views[f][slots] = [w[f] for w in chunk]
+            self.versions[slots] += 1  # even: stable
+            with h.lock:
+                h.count.value = total + k
+            done += k
+        return len(windows)
 
     # ---------------------------------------------------------------- reader
     @property

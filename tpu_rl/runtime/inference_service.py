@@ -372,6 +372,13 @@ class InferenceClient:
             identity=identity or f"w{wid}-{uuid.uuid4().hex[:8]}".encode(),
         )
 
+    @property
+    def n_rejected(self) -> int:
+        """Corrupt/foreign replies dropped by the DEALER — surfaced so the
+        worker's stat dict covers this receive channel too, not just the
+        model SUB."""
+        return self.dealer.n_rejected
+
     def act(self, obs: np.ndarray, first: np.ndarray) -> dict | None:
         cfg = self.cfg
         req = {"wid": self.wid, "seq": self.seq, "obs": obs, "first": first}

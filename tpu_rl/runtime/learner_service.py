@@ -605,6 +605,14 @@ class LearnerService:
                     "transport-rejected-frames", float(sa[3])
                 )
                 self.timer.record_gauge("worker-model-loads", float(sa[4]))
+            if len(sa) > 6:
+                # Relay health (storage._relay_stat slots 5/6): frames shed
+                # by the manager's drop-oldest queue and wire bytes forwarded
+                # to storage — the fan-in path's loss and volume odometers.
+                self.timer.record_gauge("relay-dropped-frames", float(sa[5]))
+                self.timer.record_gauge(
+                    "manager-forward-bytes", float(sa[6])
+                )
             sa[2] = 0.0
 
     def _stopped(self) -> bool:

@@ -9,6 +9,16 @@ bounded drop-oldest queue (deque maxlen 1024, ``manager.py:45-47``) is kept —
 back-pressure on a best-effort fleet means shedding the *oldest* data, since
 stale rollouts are the least on-policy.
 
+Zero-copy relay (``Config.relay_mode="raw"``, the default): the manager never
+inspects rollout payloads, so it routes on the proto byte alone —
+``protocol.peek`` validates the header (magic/version/size caps) without the
+CRC pass, LZ4 decompress, or schema unpack, and the received wire parts are
+forwarded verbatim via ``Pub.send_raw``. Per-frame relay cost drops from
+O(payload) (decode + re-encode) to O(1); the single full CRC+decode runs at
+the storage edge, the only consumer. Only the rare, tiny ``Stat`` frames are
+decoded here, for the windowed mean. ``relay_mode="decode"`` keeps the old
+decode-re-encode hop as the A/B baseline (``bench_relay.cpu.json``).
+
 Sync loop instead of the reference's two asyncio tasks: one poll-drain-forward
 pass per iteration keeps ordering within a worker's stream and needs no
 coordination.
@@ -20,7 +30,7 @@ import time
 from collections import deque
 
 from tpu_rl.config import Config
-from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.protocol import Protocol, decode, encode
 from tpu_rl.runtime.transport import Pub, Sub
 
 RELAY_QUEUE_MAX = 1024  # reference manager.py:45-47
@@ -38,14 +48,28 @@ class Manager:
         heartbeat=None,
     ):
         self.cfg = cfg
+        self.raw = cfg.relay_mode == "raw"
         self.worker_port = worker_port
         self.learner_addr = (learner_ip, learner_port)
         self.stop_event = stop_event
         self.heartbeat = heartbeat
+        # Relay queue holds fully-encoded wire parts (list[bytes]) in BOTH
+        # modes: raw mode appends the received parts untouched; decode mode
+        # decodes + re-encodes at ingest (the A/B baseline's per-frame
+        # codec cost), so the flush path is mode-agnostic byte forwarding.
         self.queue: deque = deque(maxlen=RELAY_QUEUE_MAX)
         self.stat_q: deque = deque(maxlen=STAT_WINDOW)
         self.n_stats = 0
         self.n_forwarded = 0
+        # Observability (ISSUE 3 satellites): frames shed by the drop-oldest
+        # deque (previously silent data loss) and bytes forwarded to storage
+        # — both relayed in the windowed stat publish so they land on the
+        # learner's dashboards next to transport-rejected-frames.
+        self.n_dropped = 0
+        self.n_forward_bytes = 0
+        # Stat frames that passed peek but failed the full decode (raw mode
+        # decodes only stats; a corrupt stat body is dropped + counted).
+        self.n_stat_rejected = 0
         # Per-worker health counters (last-seen cumulative values, keyed by
         # wid) relayed in the windowed stat publish so they reach the
         # learner's dashboards (ISSUE 2 satellites: n_model_loads,
@@ -57,6 +81,7 @@ class Manager:
     def run(self) -> None:
         sub = self._sub = Sub("*", self.worker_port, bind=True)
         pub = Pub(*self.learner_addr, bind=False)
+        recv = sub.recv_raw if self.raw else sub.recv
         try:
             while not self._stopped():
                 moved = self._pump(sub, pub)
@@ -64,7 +89,7 @@ class Manager:
                     self.heartbeat.value = time.time()
                 if not moved:
                     # Idle: block briefly on the socket instead of spinning.
-                    msg = sub.recv(timeout_ms=50)
+                    msg = recv(timeout_ms=50)
                     if msg is not None:
                         self._ingest(*msg, pub)
         finally:
@@ -74,50 +99,75 @@ class Manager:
     # ---------------------------------------------------------------- pump
     def _pump(self, sub: Sub, pub: Pub) -> int:
         moved = 0
-        for proto, payload in sub.drain():
-            self._ingest(proto, payload, pub)
+        drain = sub.drain_raw if self.raw else sub.drain
+        for proto, item in drain():
+            self._ingest(proto, item, pub)
             moved += 1
         while self.queue:
-            pub.send(*self.queue.popleft())
+            parts = self.queue.popleft()
+            pub.send_raw(parts)
             self.n_forwarded += 1
+            self.n_forward_bytes += len(parts[0]) + len(parts[1])
             moved += 1
         return moved
 
-    def _ingest(self, proto: Protocol, payload, pub: Pub) -> None:
+    def _ingest(self, proto: Protocol, item, pub: Pub) -> None:
+        """One received message. ``item`` is the opaque wire-parts list in
+        raw mode, the decoded payload in decode mode."""
         if proto in (Protocol.Rollout, Protocol.RolloutBatch):
             # Relay a RolloutBatch as one frame — never unpacked into
-            # per-step messages (the SUB/PUB hop still decodes+re-encodes
-            # once per frame, so batching also cuts this hop's codec calls
-            # N-fold). Drop-oldest granularity is therefore one frame: a
+            # per-step messages. Drop-oldest granularity is one frame: a
             # whole tick for batched workers, exactly the steps that are
             # most stale together.
-            self.queue.append((proto, payload))  # drop-oldest at maxlen
+            parts = item if self.raw else encode(proto, item)
+            if len(self.queue) == self.queue.maxlen:
+                # deque(maxlen) evicts silently; count the shed frame so the
+                # loss is visible fleet-wide (satellite: silent drop fix).
+                self.n_dropped += 1
+            self.queue.append(parts)
         elif proto == Protocol.Stat:
-            # Workers send either the reference's bare episode reward or the
-            # dict form carrying per-worker health counters.
-            if isinstance(payload, dict):
-                self.stat_q.append(float(payload.get("rew", 0.0)))
-                wid = payload.get("wid", -1)
-                self.model_loads[wid] = int(payload.get("n_model_loads", 0))
-                self.worker_rejected[wid] = int(payload.get("n_rejected", 0))
-            else:
-                self.stat_q.append(float(payload))
-            self.n_stats += 1
-            if self.n_stats % STAT_WINDOW == 0:
-                mean = sum(self.stat_q) / len(self.stat_q)
-                own_rejected = self._sub.n_rejected if self._sub else 0
-                pub.send(
-                    Protocol.Stat,
-                    {
-                        "mean": mean,
-                        "n": len(self.stat_q),
-                        # Fleet totals: this relay's own corrupt-frame drops
-                        # plus every worker's model-SUB drops / reloads.
-                        "rejected": own_rejected
-                        + sum(self.worker_rejected.values()),
-                        "model_loads": sum(self.model_loads.values()),
-                    },
-                )
+            if self.raw:
+                # Stats are the one frame kind the manager consumes: full
+                # decode (CRC included) of a tiny payload, a few per episode.
+                try:
+                    _, item = decode(item)
+                except ValueError:
+                    self.n_stat_rejected += 1
+                    return
+            self._ingest_stat(item, pub)
+
+    def _ingest_stat(self, payload, pub: Pub) -> None:
+        # Workers send either the reference's bare episode reward or the
+        # dict form carrying per-worker health counters.
+        if isinstance(payload, dict):
+            self.stat_q.append(float(payload.get("rew", 0.0)))
+            wid = payload.get("wid", -1)
+            self.model_loads[wid] = int(payload.get("n_model_loads", 0))
+            self.worker_rejected[wid] = int(payload.get("n_rejected", 0))
+        else:
+            self.stat_q.append(float(payload))
+        self.n_stats += 1
+        if self.n_stats % STAT_WINDOW == 0:
+            mean = sum(self.stat_q) / len(self.stat_q)
+            own_rejected = self._sub.n_rejected if self._sub else 0
+            pub.send(
+                Protocol.Stat,
+                {
+                    "mean": mean,
+                    "n": len(self.stat_q),
+                    # Fleet totals: this relay's own corrupt-frame drops
+                    # (peek rejects + stat-decode rejects) plus every
+                    # worker's model-SUB drops / reloads.
+                    "rejected": own_rejected
+                    + self.n_stat_rejected
+                    + sum(self.worker_rejected.values()),
+                    "model_loads": sum(self.model_loads.values()),
+                    # Relay health (ISSUE 3): drop-oldest evictions and
+                    # forwarded wire bytes -> learner gauges.
+                    "relay_dropped": self.n_dropped,
+                    "forward_bytes": self.n_forward_bytes,
+                },
+            )
 
     def _stopped(self) -> bool:
         return self.stop_event is not None and self.stop_event.is_set()

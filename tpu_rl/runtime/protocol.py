@@ -308,6 +308,36 @@ def encode(proto: Protocol, payload: Any) -> list[bytes]:
     return [bytes([proto]), header + body]
 
 
+def peek(parts: list[bytes]) -> Protocol:
+    """Cheap relay-hop validation of a multipart frame: proto byte, header
+    magic/version, known codec, declared-size cap — WITHOUT the CRC pass,
+    decompression, or unpack that :func:`decode` performs. O(1) in the
+    payload size, so a relay can route millions of frames/s on the proto
+    byte alone. The full CRC + decode runs once, at the storage edge — the
+    only hop that consumes rollout payloads. Raises ValueError on frames a
+    relay must not forward (foreign publishers, truncated frames, hostile
+    size declarations); a corrupt *body* under a valid header passes peek
+    and is rejected downstream by decode's CRC."""
+    if len(parts) != 2 or len(parts[0]) != 1:
+        raise ValueError(f"malformed multipart message: {len(parts)} parts")
+    proto = Protocol(parts[0][0])  # ValueError on an unknown proto byte
+    frame = parts[1]
+    if len(frame) < _HEADER.size:
+        raise ValueError("short frame")
+    magic, version, codec, raw_size, _crc32 = _HEADER.unpack_from(frame)
+    if magic != _MAGIC or version != _VERSION:
+        raise ValueError(f"bad frame magic/version {magic:#x}/{version}")
+    if raw_size > _MAX_RAW:
+        raise ValueError(f"declared raw size {raw_size} exceeds cap {_MAX_RAW}")
+    if codec == Codec.RAW:
+        # Uncompressed body: the size invariant is free to check here.
+        if len(frame) - _HEADER.size != raw_size:
+            raise ValueError("raw body size mismatch")
+    elif codec not in (Codec.LZ4, Codec.ZLIB):
+        raise ValueError(f"unknown codec {codec}")
+    return proto
+
+
 def decode(parts: list[bytes]) -> tuple[Protocol, Any]:
     """Inverse of :func:`encode` (reference ``decode``,
     ``utils/utils.py:248-249``). Raises ValueError on malformed frames."""

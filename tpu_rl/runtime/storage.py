@@ -7,6 +7,15 @@ learner port, push Rollout steps through the assembler, write completed
 windows into the shm store, relay episode-reward stats into the 3-float stat
 mailbox ``[global_game_count, mean_rew, activate]``
 (``learner_storage.py:104-121``, created at ``main.py:324-326``).
+
+This is the storage edge of the zero-copy fan-in (ISSUE 3): the one hop that
+runs the full frame validation (CRC + decompress + schema unpack, inside
+``Sub.recv``/``drain``) — relays upstream only ``peek`` the header. Whole
+worker ticks then enter the assembler columnar-wise via
+``RolloutAssembler.push_tick`` (row views per env, no per-step dicts) and
+completed windows leave in bursts via the stores' ``put_many`` (one slice
+write per field). ``Config.relay_mode="decode"`` keeps the per-step
+``split_rollout_batch`` + ``push`` reference path as the A/B baseline.
 """
 
 from __future__ import annotations
@@ -20,11 +29,13 @@ from tpu_rl.data.shm_ring import ShmHandles, make_store
 from tpu_rl.runtime.protocol import Protocol
 from tpu_rl.runtime.transport import Sub
 
-# [game_count, mean_rew, activate, rejected_frames, model_loads] — the first
-# three are the reference's 3-float mailbox (``main.py:324-326``); the fleet
-# health slots (transport corrupt-frame drops, worker model reloads) ride the
-# same activate flag and become learner timer gauges (ISSUE 2 satellites).
-STAT_SLOTS = 5
+# [game_count, mean_rew, activate, rejected_frames, model_loads,
+#  relay_dropped, forward_bytes] — the first three are the reference's 3-float
+# mailbox (``main.py:324-326``); the fleet health slots (transport
+# corrupt-frame drops, worker model reloads — ISSUE 2, and the manager's
+# drop-oldest evictions + forwarded wire bytes — ISSUE 3) ride the same
+# activate flag and become learner timer gauges.
+STAT_SLOTS = 7
 
 
 class LearnerStorage:
@@ -74,21 +85,28 @@ class LearnerStorage:
             # One worker tick, all envs stacked: unpack at the storage edge
             # (the only hop that needs per-step granularity — the assembler
             # keys on episode id).
-            for step in split_rollout_batch(payload):
-                assembler.push(step)
+            if self.cfg.relay_mode == "decode":
+                # A/B baseline: per-step dicts through the scalar push path.
+                for step in split_rollout_batch(payload):
+                    assembler.push(step)
+            else:
+                # Columnar: the whole tick in one call, row views per env.
+                assembler.push_tick(payload)
         elif proto == Protocol.Stat:
             self._relay_stat(payload)
 
     def _flush(self, assembler: RolloutAssembler, store) -> None:
-        while (window := assembler.pop()) is not None:
-            if not store.put(window):
-                # On-policy store full: the learner hasn't consumed yet.
-                # Requeue the window and yield (reference spins on
-                # ``num < mem_size``, ``learner_storage.py:139``).
-                assembler.ready.appendleft(window)
-                self.n_requeue_full += 1
-                break
-            self.n_windows += 1
+        windows = assembler.pop_many()
+        if not windows:
+            return
+        accepted = store.put_many(windows)
+        self.n_windows += accepted
+        if accepted < len(windows):
+            # On-policy store full: the learner hasn't consumed yet. Requeue
+            # the rejected tail in order and yield (reference spins on
+            # ``num < mem_size``, ``learner_storage.py:139``).
+            assembler.ready.extendleft(reversed(windows[accepted:]))
+            self.n_requeue_full += 1
 
     def _relay_stat(self, payload) -> None:
         """Manager sends ``{"mean": m, "n": window}``; fold into the stat
@@ -116,6 +134,11 @@ class LearnerStorage:
                 float(payload.get("model_loads", 0.0))
                 if isinstance(payload, dict) else 0.0
             )
+        if len(self.stat_array) > 6 and isinstance(payload, dict):
+            # Relay health (ISSUE 3): manager drop-oldest evictions and
+            # forwarded wire bytes -> learner gauges.
+            self.stat_array[5] = float(payload.get("relay_dropped", 0.0))
+            self.stat_array[6] = float(payload.get("forward_bytes", 0.0))
         self.stat_array[2] = 1.0  # activate flag; learner clears it
 
     def _stopped(self) -> bool:

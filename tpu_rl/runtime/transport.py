@@ -28,7 +28,7 @@ from typing import Any, Iterator
 import zmq
 import zmq.asyncio
 
-from tpu_rl.runtime.protocol import Protocol, decode, encode
+from tpu_rl.runtime.protocol import Protocol, decode, encode, peek
 
 # Keep only the newest model broadcast in flight (a worker that lags wants the
 # freshest params, not a backlog); rollout channels buffer more.
@@ -53,6 +53,11 @@ class Pub:
 
     def send(self, proto: Protocol, payload: Any) -> None:
         self.sock.send_multipart(encode(proto, payload))
+
+    def send_raw(self, parts: list[bytes]) -> None:
+        """Forward already-encoded wire parts verbatim — the zero-copy relay
+        hop (no pack/compress/CRC; zmq ships the same buffers it received)."""
+        self.sock.send_multipart(parts)
 
     def close(self) -> None:
         self.sock.close(linger=0)
@@ -95,6 +100,38 @@ class Sub:
                 return
             try:
                 yield decode(parts)
+            except ValueError:
+                self.n_rejected += 1
+
+    def recv_raw(
+        self, timeout_ms: int | None = None
+    ) -> tuple[Protocol, list[bytes]] | None:
+        """Blocking (or timed) receive of one frame as opaque wire parts,
+        validated by :func:`protocol.peek` only (proto byte, header, size
+        caps — no CRC/decompress/unpack). None on timeout or on a rejected
+        frame (counted in ``n_rejected``, same contract as :meth:`recv`)."""
+        if timeout_ms is not None:
+            if not self.sock.poll(timeout_ms):
+                return None
+        parts = self.sock.recv_multipart()
+        try:
+            return peek(parts), parts
+        except ValueError:
+            self.n_rejected += 1
+            return None
+
+    def drain_raw(
+        self, max_msgs: int = 1024
+    ) -> Iterator[tuple[Protocol, list[bytes]]]:
+        """Yield every queued frame as peek-validated opaque wire parts,
+        newest-bounded (the raw-relay counterpart of :meth:`drain`)."""
+        for _ in range(max_msgs):
+            try:
+                parts = self.sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            try:
+                yield peek(parts), parts
             except ValueError:
                 self.n_rejected += 1
 

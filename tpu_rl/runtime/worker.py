@@ -104,6 +104,11 @@ class Worker:
             remote = InferenceClient(
                 cfg, learner_ip, self.inference_port, wid=self.worker_id
             )
+        # Corrupt-reply count on the inference DEALER, captured before the
+        # fallback closes the client so the total survives into later stat
+        # publishes (satellite of ISSUE 3: remote-acting drops were invisible
+        # — only the model-SUB count reached the dashboards).
+        remote_rejected = 0
 
         # Vectorized acting: N envs stepped per tick with ONE batched policy
         # forward (worker_num_envs; N=1 reproduces the reference's
@@ -157,6 +162,7 @@ class Worker:
                         file=sys.stderr,
                         flush=True,
                     )
+                    remote_rejected = remote.n_rejected
                     remote.close()
                     remote = None
                     self.fell_back = True
@@ -212,13 +218,18 @@ class Worker:
                         # Episode stat rides as a dict so per-worker health
                         # counters (model reloads — satellite of ISSUE 2)
                         # reach the dashboards; the manager also accepts the
-                        # reference's bare-float form.
+                        # reference's bare-float form. n_rejected covers both
+                        # of this worker's receive channels: the model SUB
+                        # and (when acting remotely) the inference DEALER.
+                        if remote is not None:
+                            remote_rejected = remote.n_rejected
                         pub.send(
                             Protocol.Stat,
                             {
                                 "rew": float(epi_rew[i]),
                                 "n_model_loads": n_model_loads,
-                                "n_rejected": model_sub.n_rejected,
+                                "n_rejected": model_sub.n_rejected
+                                + remote_rejected,
                                 "wid": self.worker_id,
                             },
                         )
