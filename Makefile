@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint format-check test relay-smoke ci
+.PHONY: lint format-check test relay-smoke obs-smoke ci
 
 lint:
 	ruff check .
@@ -23,4 +23,9 @@ relay-smoke:
 	JAX_PLATFORMS=cpu TPU_RL_BENCH_RELAY=1 TPU_RL_BENCH_RELAY_LIGHT=1 \
 		$(PY) bench.py > /dev/null
 
-ci: lint test relay-smoke
+# Telemetry-plane smoke: boot the smallest real cluster with the plane on,
+# scrape /metrics + /healthz mid-run, validate telemetry.json + trace.json.
+obs-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/obs_smoke.py
+
+ci: lint test relay-smoke obs-smoke

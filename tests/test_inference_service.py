@@ -300,6 +300,9 @@ def _run_worker_capture(cfg, port_base, inference_port, n_frames=3,
 ROLLOUT_KEYS = (
     "obs", "act", "rew", "logits", "log_prob", "is_fir", "hx", "cx", "id",
     "done",
+    # telemetry echo (tpu_rl.obs): worker id + policy version ride every
+    # tick in BOTH acting modes, so layout parity must cover them too
+    "wid", "ver",
 )
 
 

@@ -1,0 +1,322 @@
+"""Telemetry-plane unit tests (tpu_rl.obs): registry snapshot/merge/diff
+round-trips, Prometheus exposition golden output, aggregator staleness math,
+Chrome trace-event schema, the HTTP exporter, and the zero-overhead guarantee
+of the disabled path. The live worker->storage version echo and the cluster
+/metrics scrape live in test_obs_runtime.py / test_runtime.py.
+"""
+
+import json
+import tracemalloc
+import urllib.request
+
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.obs import (
+    HIST_BUCKETS,
+    JsonExporter,
+    MetricsRegistry,
+    PeriodicSnapshot,
+    TelemetryAggregator,
+    TelemetryHTTPServer,
+    TraceRecorder,
+    diff_snapshots,
+    maybe_aggregator,
+    merge_snapshots,
+    render_healthz,
+    render_prometheus,
+)
+from tpu_rl.runtime.protocol import Protocol, decode, encode
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_snapshot_wire_round_trip():
+    """A snapshot IS a Telemetry payload: it must survive the closed-schema
+    wire codec bit-exactly (no adapter layer between registry and wire)."""
+    reg = MetricsRegistry(role="worker", labels={"wid": "3"}, host="h", pid=42)
+    reg.counter("worker-env-steps").inc(17)
+    reg.gauge("worker-policy-version").set(5)
+    reg.histogram("tick-time", labels={"phase": "act"}).observe(0.002)
+    snap = reg.snapshot()
+    proto, back = decode(encode(Protocol.Telemetry, snap))
+    assert proto == Protocol.Telemetry
+    assert back == snap
+    # constant registry labels merged into each series
+    assert back["counters"][0] == ["worker-env-steps", {"wid": "3"}, 17.0]
+    assert back["hists"][0][1] == {"wid": "3", "phase": "act"}
+
+
+def test_registry_merge_and_diff():
+    a = MetricsRegistry(role="w", pid=1, host="h")
+    b = MetricsRegistry(role="w", pid=1, host="h")
+    for reg, k in ((a, 3), (b, 5)):
+        reg.counter("c").inc(k)
+        reg.histogram("h").observe(float(k))
+        reg.gauge("g").set(float(k))
+    sa, sb = a.snapshot(), b.snapshot()
+    merged = merge_snapshots(sa, sb)
+    assert dict((n, v) for n, _l, v in merged["counters"]) == {"c": 8.0}
+    (_, _, counts, total, count) = merged["hists"][0]
+    assert (total, count) == (8.0, 2)
+    assert sum(counts) == 2
+    # gauges: newest ts wins (sb snapshotted second)
+    assert merged["gauges"][0][2] == 5.0
+    # diff is the additive inverse over counters/hist slots
+    d = diff_snapshots(merged, sa)
+    assert d["counters"][0][2] == 5.0
+    assert d["hists"][0][4] == 1
+    # floored at zero: a restarted source never yields negative rates
+    d2 = diff_snapshots(sa, merged)
+    assert d2["counters"][0][2] == 0.0
+
+
+def test_histogram_bucket_layout():
+    reg = MetricsRegistry(role="r", pid=0, host="h")
+    h = reg.histogram("lat")
+    h.observe(2.0 ** -14)  # == first bound -> first slot (le is inclusive)
+    h.observe(1e9)  # past the last bound -> overflow slot
+    assert len(h.counts) == len(HIST_BUCKETS) + 1
+    assert h.counts[0] == 1 and h.counts[-1] == 1
+
+
+def test_periodic_snapshot_wall_clock_gating():
+    """The emitter fires on the CLOCK, not on activity — the satellite that
+    makes idle/stuck workers visible to /healthz."""
+    sent = []
+    t = [0.0]
+    reg = MetricsRegistry(role="w", pid=0, host="h")
+    em = PeriodicSnapshot(reg, sent.append, interval_s=5.0, clock=lambda: t[0])
+    assert em.maybe_emit()  # first call ships immediately
+    assert not em.maybe_emit()  # same instant: gated
+    t[0] = 4.9
+    assert not em.maybe_emit()
+    t[0] = 5.0
+    assert em.maybe_emit()
+    assert len(sent) == 2 and sent[0]["role"] == "w"
+
+
+# --------------------------------------------------------------- aggregator
+def test_aggregator_staleness_math():
+    t = [0.0]
+    agg = TelemetryAggregator(
+        registry=MetricsRegistry(role="storage", pid=0, host="h"),
+        stale_after_s=10.0,
+        clock=lambda: t[0],
+    )
+    # The learner's gauge is the authoritative max version.
+    learner = MetricsRegistry(role="learner", pid=1, host="h")
+    learner.gauge("learner-update-index").set(10)
+    assert agg.ingest(learner.snapshot())
+    assert agg.max_version == 10
+    agg.observe_staleness(wid=0, version=7)  # 3 updates stale
+    agg.observe_staleness(wid=0, version=10)  # fresh
+    agg.observe_staleness(wid=1, version=12)  # echo ratchets the bound
+    assert agg.max_version == 12
+    agg.observe_staleness(wid=1, version=-1)  # unversioned: ignored
+    h0 = agg.registry.histogram("policy-staleness-updates", labels={"wid": "0"})
+    h1 = agg.registry.histogram("policy-staleness-updates", labels={"wid": "1"})
+    assert h0.count == 2 and h0.sum == 3.0
+    assert h1.count == 1 and h1.sum == 0.0
+
+
+def test_aggregator_rejects_foreign_payloads():
+    agg = TelemetryAggregator()
+    assert not agg.ingest({"mean": 1.0})  # a Stat dict is not a snapshot
+    assert not agg.ingest("junk")
+    assert agg.n_rejected == 2 and not agg.sources
+
+
+def test_aggregator_role_health_staleness():
+    t = [0.0]
+    agg = TelemetryAggregator(stale_after_s=10.0, clock=lambda: t[0])
+    w = MetricsRegistry(role="worker", pid=7, host="h")
+    agg.ingest(w.snapshot())
+    assert agg.role_health()["worker"]["alive"]
+    assert agg.healthy()
+    t[0] = 11.0  # worker silent past the window
+    health = agg.role_health()
+    assert not health["worker"]["alive"]
+    assert health["storage"]["alive"]  # own role: always answering
+    assert not agg.healthy()
+    status, body = render_healthz(agg)
+    assert status == 503 and body["status"] == "stale"
+    agg.ingest(w.snapshot())  # fresh frame revives the role
+    assert render_healthz(agg)[0] == 200
+
+
+# ------------------------------------------------------------- prometheus
+def test_prometheus_exposition_golden():
+    """Pin the exact exposition text (format 0.0.4) for a small fixed
+    aggregator state — sorting, TYPE lines, label escaping, cumulative
+    buckets, +Inf, _sum/_count."""
+    agg = TelemetryAggregator(
+        registry=MetricsRegistry(role="storage", pid=1, host="host0"),
+        clock=lambda: 0.0,
+    )
+    reg = agg.registry
+    reg.counter("storage-windows").inc(4)
+    reg.gauge("storage-game-count").set(2)
+    h = reg.histogram("policy-staleness-updates", labels={"wid": "0"})
+    h.observe(0.0)  # first slot (bisect_left: 0.0 < 2^-14)
+    h.observe(3.0)  # between 2^1 and 2^2
+    text = render_prometheus(agg)
+    lines = text.splitlines()
+    assert lines[0] == "# TYPE storage_windows counter"
+    assert lines[1] == (
+        'storage_windows{host="host0",pid="1",role="storage"} 4'
+    )
+    assert lines[2] == "# TYPE storage_game_count gauge"
+    assert lines[3] == (
+        'storage_game_count{host="host0",pid="1",role="storage"} 2'
+    )
+    assert lines[4] == "# TYPE policy_staleness_updates histogram"
+    # cumulative le buckets over the shared layout
+    b = [ln for ln in lines if ln.startswith("policy_staleness_updates_bucket")]
+    assert len(b) == len(HIST_BUCKETS) + 1  # bounds + +Inf
+    assert b[0] == (
+        'policy_staleness_updates_bucket{host="host0",le="6.103515625e-05",'
+        'pid="1",role="storage",wid="0"} 1'
+    )
+    assert b[-1] == (
+        'policy_staleness_updates_bucket{host="host0",le="+Inf",pid="1",'
+        'role="storage",wid="0"} 2'
+    )
+    assert lines[-2] == (
+        'policy_staleness_updates_sum{host="host0",pid="1",role="storage",'
+        'wid="0"} 3'
+    )
+    assert lines[-1] == (
+        'policy_staleness_updates_count{host="host0",pid="1",role="storage",'
+        'wid="0"} 2'
+    )
+    # every sample line parses as name{labels} value
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        name_part, _, val = ln.rpartition(" ")
+        float(val)
+        assert name_part[0].isalpha()
+
+
+def test_prometheus_cumulative_bucket_monotonicity():
+    agg = TelemetryAggregator()
+    h = agg.registry.histogram("x")
+    for v in (0.001, 0.5, 2.0, 1e7):
+        h.observe(v)
+    text = render_prometheus(agg)
+    counts = [
+        int(ln.rpartition(" ")[2])
+        for ln in text.splitlines()
+        if ln.startswith("x_bucket")
+    ]
+    assert counts == sorted(counts) and counts[-1] == 4
+
+
+# ------------------------------------------------------------- http server
+@pytest.mark.timeout(30)
+def test_http_exporter_metrics_and_healthz():
+    agg = TelemetryAggregator()
+    agg.registry.counter("storage-windows").inc(2)
+    srv = TelemetryHTTPServer(agg, port=0)  # ephemeral port
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert "0.0.4" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "storage_windows" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert r.status == 200
+            doc = json.loads(r.read())
+        assert doc["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------------ trace
+def test_trace_chrome_schema(tmp_path):
+    tr = TraceRecorder(capacity=8, pid=123)
+    with tr.span("assemble", tid="feeder"):
+        pass
+    with tr.span("train-step"):
+        pass
+    doc = tr.to_chrome()
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert [e["name"] for e in events] == ["assemble", "train-step"]
+    for e in events:
+        assert e["pid"] == 123
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] >= 0.0
+    # two lanes, named via thread_name metadata
+    assert {m["args"]["name"] for m in metas} == {"feeder", "main"}
+    assert len({e["tid"] for e in events}) == 2
+    # ring: capacity bounds the buffer, recording never fails
+    for i in range(50):
+        tr.add(f"s{i}", 0.0, 0.001)
+    assert len(tr) == 8 and tr.n_recorded == 52
+    path = tmp_path / "trace.json"
+    tr.dump(str(path))
+    loaded = json.loads(path.read_text())  # valid JSON on disk
+    assert loaded["displayTimeUnit"] == "ms"
+
+
+# ------------------------------------------------------------ json exporter
+def test_json_exporter_rolling_snapshot(tmp_path):
+    t = [0.0]
+    agg = TelemetryAggregator(clock=lambda: t[0])
+    agg.registry.counter("storage-windows").inc()
+    path = tmp_path / "telemetry.json"
+    exp = JsonExporter(agg, str(path), interval_s=2.0)
+    assert exp.maybe_export(now=0.0)
+    assert not exp.maybe_export(now=1.0)  # gated
+    doc = json.loads(path.read_text())
+    assert set(doc) == {"ts", "healthz", "sources"}
+    assert doc["healthz"]["status"] == "ok"
+    assert doc["sources"][0]["role"] == "storage"
+    assert exp.maybe_export(now=2.5) and exp.n_written == 2
+
+
+# ----------------------------------------------------- disabled = zero cost
+def test_disabled_telemetry_allocates_nothing():
+    """Acceptance pin: with telemetry_port=0 and result_dir=None the plane
+    is never constructed — storage opens no server, and its per-frame tick
+    path allocates nothing (the hot-loop guard is one `is None` check)."""
+    from tpu_rl.runtime.storage import LearnerStorage
+
+    cfg = small_config(telemetry_port=0, result_dir=None)
+    assert not cfg.telemetry_enabled
+    assert maybe_aggregator(cfg) is None
+    st = LearnerStorage(cfg, handles=None, learner_port=0)
+    st._setup_telemetry()
+    assert st.aggregator is None and st._http is None
+    assert st._json_exp is None and st._tb_exp is None
+
+    # The disabled ingest path for a Telemetry frame and a versioned
+    # RolloutBatch must be allocation-free (measured, not assumed).
+    telemetry_payload = {"role": "worker", "pid": 1, "host": "h"}
+    for _ in range(64):  # warm any lazy interpreter state
+        st._ingest(Protocol.Telemetry, telemetry_payload, assembler=None)
+    tracemalloc.start()
+    snap1 = tracemalloc.take_snapshot()
+    for _ in range(256):
+        st._ingest(Protocol.Telemetry, telemetry_payload, assembler=None)
+    snap2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    here = [
+        s
+        for s in snap2.compare_to(snap1, "lineno")
+        if s.traceback[0].filename.endswith("storage.py") and s.size_diff > 0
+    ]
+    assert not here, [str(s) for s in here]
+
+
+def test_enabled_telemetry_gate():
+    assert small_config(telemetry_port=18123).telemetry_enabled
+    assert small_config(result_dir="/tmp/x").telemetry_enabled
+    agg = maybe_aggregator(small_config(telemetry_port=18123))
+    assert isinstance(agg, TelemetryAggregator)

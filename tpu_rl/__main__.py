@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-result-dir", action="store_true",
                    help="disable tensorboard/checkpoint output")
+    p.add_argument("--telemetry-port", type=int, default=None,
+                   help="serve Prometheus /metrics + /healthz from the "
+                   "storage process on this port (0/unset = off)")
     return p
 
 
@@ -49,6 +52,8 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["algo"] = args.algo
     if args.mesh_data:
         overrides["mesh_data"] = args.mesh_data
+    if args.telemetry_port is not None:
+        overrides["telemetry_port"] = args.telemetry_port
     if overrides:
         cfg = cfg.replace(**overrides)
     machines = (

@@ -280,6 +280,25 @@ class Config:
     # wedges the fleet.
     inference_timeout_ms: int = 2000
     inference_retries: int = 2
+    # ---- telemetry plane (tpu_rl.obs) ----
+    # HTTP port for the storage-side exporter serving Prometheus text at
+    # /metrics and staleness-aware liveness at /healthz. 0 = no server, no
+    # socket. The plane as a whole (registries, Telemetry frames, the
+    # aggregator) activates iff `telemetry_enabled` — see the property.
+    telemetry_port: int = 0
+    # Wall-clock period between a role's Telemetry snapshots. Emission is on
+    # the clock, not on episode completion, so idle/stuck workers stay
+    # visible to /healthz.
+    telemetry_interval_s: float = 5.0
+    # TelemetryAggregator staleness window: a source silent longer than this
+    # is reported dead by /healthz. Should comfortably exceed
+    # telemetry_interval_s — the stat channel is best-effort PUB/SUB and one
+    # lost frame must not flap liveness.
+    telemetry_stale_s: float = 30.0
+    # TraceRecorder ring capacity (completed learner-timeline spans kept for
+    # the Chrome trace export at result_dir/trace.json). The recorder only
+    # exists when result_dir is set.
+    trace_capacity: int = 4096
 
     # ---- runtime-derived (filled by the runner, not the JSON) ----
     obs_shape: tuple[int, ...] = (4,)
@@ -329,6 +348,10 @@ class Config:
         assert self.inference_flush_us >= 0, self.inference_flush_us
         assert self.inference_timeout_ms > 0, self.inference_timeout_ms
         assert self.inference_retries >= 0, self.inference_retries
+        assert 0 <= self.telemetry_port < 65536, self.telemetry_port
+        assert self.telemetry_interval_s > 0, self.telemetry_interval_s
+        assert self.telemetry_stale_s > 0, self.telemetry_stale_s
+        assert self.trace_capacity >= 1, self.trace_capacity
         assert self.action_repeat >= 1, self.action_repeat
         assert self.std_floor >= 0.0, (
             f"std_floor must be >= 0 (got {self.std_floor}): a negative floor "
@@ -429,6 +452,16 @@ class Config:
     @property
     def effective_act_ctx(self) -> int:
         return self.act_ctx or self.seq_len
+
+    @property
+    def telemetry_enabled(self) -> bool:
+        """The single gate for the telemetry plane: collect iff the metrics
+        have somewhere to go — an HTTP scrape port or a result_dir (JSON
+        snapshot + tensorboard). Disabled (the default for tests and bare
+        runs) means registries, emitters, and the aggregator are never
+        constructed: role hot paths guard on ``is None``, so the off state
+        adds no per-frame allocations and opens no sockets."""
+        return self.telemetry_port > 0 or self.result_dir is not None
 
     def replace(self, **kw: Any) -> "Config":
         new = dataclasses.replace(self, **kw)

@@ -1,0 +1,47 @@
+"""Fleet-wide observability: metrics registries, the telemetry aggregator,
+exporters (Prometheus / JSON / tensorboard), and span tracing.
+
+See ``docs/ARCHITECTURE.md`` ("Observability") for the data flow.
+"""
+
+from tpu_rl.obs.aggregator import (
+    DEFAULT_STALE_AFTER_S,
+    LEARNER_VERSION_GAUGE,
+    STALENESS_HIST,
+    TelemetryAggregator,
+    maybe_aggregator,
+)
+from tpu_rl.obs.exporters import (
+    JsonExporter,
+    TelemetryHTTPServer,
+    TensorboardExporter,
+    render_healthz,
+    render_prometheus,
+)
+from tpu_rl.obs.registry import (
+    HIST_BUCKETS,
+    MetricsRegistry,
+    PeriodicSnapshot,
+    diff_snapshots,
+    merge_snapshots,
+)
+from tpu_rl.obs.trace import TraceRecorder
+
+__all__ = [
+    "DEFAULT_STALE_AFTER_S",
+    "HIST_BUCKETS",
+    "JsonExporter",
+    "LEARNER_VERSION_GAUGE",
+    "MetricsRegistry",
+    "PeriodicSnapshot",
+    "STALENESS_HIST",
+    "TelemetryAggregator",
+    "TelemetryHTTPServer",
+    "TensorboardExporter",
+    "TraceRecorder",
+    "diff_snapshots",
+    "maybe_aggregator",
+    "merge_snapshots",
+    "render_healthz",
+    "render_prometheus",
+]

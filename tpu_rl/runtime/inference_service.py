@@ -88,10 +88,15 @@ class InferenceService:
         ip: str = "*",
         timer: ExecutionTimer | None = None,
         seed: int = 0,
+        version: int = -1,
     ):
         self.cfg = cfg
         self.family = family
         self._params = params
+        # Policy version of the params currently served (the learner update
+        # index). Echoed in every Act reply ("ver") so remote-acting workers
+        # can tag their rollouts for the staleness histograms (tpu_rl.obs).
+        self._version = version
         self.addr = (ip, port)
         self.timer = timer or ExecutionTimer()
         self.seed = seed
@@ -123,12 +128,13 @@ class InferenceService:
         (first-request latency then excludes the XLA compile)."""
         return self._ready.wait(timeout)
 
-    def set_params(self, params) -> None:
+    def set_params(self, params, version: int = -1) -> None:
         """In-process param swap from the learner — a reference assignment
         of the device pytree, no copy, no wire. The NEXT flushed batch acts
-        with the new weights."""
+        with the new weights, and replies echo the new ``version``."""
         with self._lock:
             self._params = params
+            self._version = version
 
     def close(self, timeout: float = 10.0) -> None:
         self._stop.set()
@@ -301,6 +307,7 @@ class InferenceService:
         c = jnp.concatenate(c_parts)
         with self._lock:
             params = self._params
+            version = self._version
         a, logits, log_prob, h_pre, c_pre, h2, c2 = step(
             params, jnp.asarray(obs), h, c, jnp.asarray(first), key
         )
@@ -322,6 +329,9 @@ class InferenceService:
                 "act": a_np[off:off + n],
                 "logits": logits_np[off:off + n],
                 "log_prob": lp_np[off:off + n],
+                # Policy version these actions were sampled with — the
+                # worker echoes it into the published RolloutBatch.
+                "ver": version,
             }
             if store_carry:
                 reply["hx"] = h_pre_np[off:off + n]

@@ -32,6 +32,7 @@ TPU-first redesign:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -45,6 +46,15 @@ from tpu_rl.data.prefetch import (
     UpdateRatioGate,
 )
 from tpu_rl.data.shm_ring import ShmHandles, make_store
+from tpu_rl.runtime.mailbox import (
+    SLOT_ACTIVATE,
+    SLOT_FORWARD_BYTES,
+    SLOT_GAME_COUNT,
+    SLOT_MEAN_REW,
+    SLOT_MODEL_LOADS,
+    SLOT_REJECTED,
+    SLOT_RELAY_DROPPED,
+)
 from tpu_rl.runtime.manager import STAT_WINDOW
 from tpu_rl.runtime.protocol import Protocol
 from tpu_rl.runtime.transport import MODEL_HWM, Pub
@@ -90,7 +100,7 @@ class AsyncPublisher:
         )
         self._thread.start()
 
-    def publish(self, actor) -> None:
+    def publish(self, actor, ver: int = -1) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -99,7 +109,7 @@ class AsyncPublisher:
         snap = jax.tree.map(jnp.copy, actor)  # donation-proof device copy
         jax.tree.map(lambda x: x.copy_to_host_async(), snap)
         with self._cond:
-            self._pending = snap  # latest wins
+            self._pending = (snap, ver)  # latest wins
             self._cond.notify()
 
     def _run(self) -> None:
@@ -111,9 +121,14 @@ class AsyncPublisher:
                     self._cond.wait(timeout=0.1)
                 if self._pending is None:  # closed and flushed
                     return
-                snap, self._pending = self._pending, None
+                (snap, ver), self._pending = self._pending, None
             try:
-                self._pub.send(Protocol.Model, {"actor": jax.device_get(snap)})
+                # "ver" is the learner update index that produced these
+                # weights: workers echo it through their rollouts so storage
+                # can measure per-worker policy staleness (tpu_rl.obs).
+                self._pub.send(
+                    Protocol.Model, {"actor": jax.device_get(snap), "ver": ver}
+                )
             except BaseException as e:  # noqa: BLE001 — surfaces in publish()
                 self._error = e
                 return
@@ -138,6 +153,7 @@ class LearnerService:
         publish_interval: int = 1,
         seed: int = 0,
         inference_port: int | None = None,
+        stat_port: int | None = None,
     ):
         self.cfg = cfg
         self.handles = handles
@@ -149,8 +165,13 @@ class LearnerService:
         self.publish_interval = publish_interval
         self.seed = seed
         self.inference_port = inference_port
+        # Stat-channel port (the one storage SUB-binds): the learner's own
+        # Telemetry snapshots ship there over a tiny local PUB — storage is
+        # colocated (same runner host), so 127.0.0.1 always reaches it.
+        self.stat_port = stat_port
         self._publisher: AsyncPublisher | None = None
         self._inference = None  # InferenceService when act_mode="remote"
+        self._tracer = None  # TraceRecorder when result_dir is set
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -282,6 +303,30 @@ class LearnerService:
         )
         writer = make_writer(cfg.result_dir)
         logger = LearnerLogger(writer, cfg.algo)
+        # Telemetry plane (tpu_rl.obs): the learner ships its own registry
+        # snapshots to the storage-side aggregator over the stat channel —
+        # the same port every other role's telemetry already converges on.
+        # None when disabled: the hot loop then pays one `is None` check per
+        # update and opens no extra socket (pinned by tests/test_obs.py).
+        telem_reg = telem_pub = None
+        telem_last = float("-inf")
+        if cfg.telemetry_enabled and self.stat_port is not None:
+            from tpu_rl.obs import MetricsRegistry
+
+            telem_reg = MetricsRegistry(role="learner")
+            telem_pub = Pub("127.0.0.1", self.stat_port, bind=False)
+        # Span tracing: ring buffer over the batch timeline (assemble ->
+        # queue-wait -> H2D -> train_step -> broadcast), dumped as Chrome
+        # trace-event JSON at result_dir/trace.json on every loss-log flush.
+        # The deep-dive companion is the jax.profiler window below
+        # (profile_dir/profile_start/profile_steps).
+        if cfg.result_dir is not None:
+            from tpu_rl.obs import TraceRecorder
+
+            self._tracer = TraceRecorder(
+                capacity=cfg.trace_capacity, pid=os.getpid()
+            )
+        tracer = self._tracer
         # One timed window per DISPATCH; a chained dispatch carries
         # chain x (seq x batch) transitions. Kept on self so harnesses
         # (examples/run_tpu_e2e_learner.py) can read the steady-state
@@ -308,12 +353,13 @@ class LearnerService:
                 self.inference_port,
                 timer=timer,
                 seed=self.seed,
+                version=start_idx,
             ).start()
             self._inference.wait_ready()
 
         # First broadcast so workers act with the resumed/initial policy
         # rather than their own random init.
-        self._publish(pub, state)
+        self._publish(pub, state, ver=start_idx)
 
         if (
             self.max_updates is not None
@@ -361,11 +407,16 @@ class LearnerService:
                 key, sub_key = jax.random.split(key)
                 state, metrics = train_step(state, batch, sub_key)
                 step_secs = time.perf_counter() - t_step
+                if tracer is not None:
+                    tracer.add("queue-wait", t_wait, wait_secs)
+                    tracer.add("train-step", t_step, step_secs)
                 if self._inference is not None:
                     # Snapshot (not reference): the NEXT dispatch donates
                     # this state's buffers, and the serve thread must never
                     # act on deleted arrays.
-                    self._inference.set_params(self._actor_snapshot(state))
+                    self._inference.set_params(
+                        self._actor_snapshot(state), version=idx + chain
+                    )
                 # learner-batching-time is the feed-side host work (shm
                 # copies + assembly + H2D placement). With prefetch it
                 # overlaps the device step, so the per-dispatch critical
@@ -413,13 +464,20 @@ class LearnerService:
                         jax.profiler.stop_trace()
                         profiling = False
                 if _crossed(prev_idx, idx, self.publish_interval):
-                    self._publish(pub, state)
+                    self._publish(pub, state, ver=idx)
+                if telem_reg is not None:
+                    now_m = time.monotonic()
+                    if now_m - telem_last >= cfg.telemetry_interval_s:
+                        telem_last = now_m
+                        self._emit_telemetry(telem_reg, telem_pub, timer, idx)
                 if _crossed(prev_idx, idx, cfg.loss_log_interval):
                     jax.block_until_ready(metrics)
                     logger.log_losses(idx, {k: float(v) for k, v in metrics.items()})
                     logger.log_timers(idx, timer)
                     self._log_fleet_stat(logger)
                     logger.flush()
+                    if tracer is not None:
+                        tracer.dump(os.path.join(cfg.result_dir, "trace.json"))
                 if ckpt is not None and _crossed(
                     prev_idx, idx, cfg.model_save_interval
                 ):
@@ -458,6 +516,13 @@ class LearnerService:
             if ckpt is not None and idx > start_idx:
                 ckpt.save(state, idx)
                 ckpt.close()
+            if telem_reg is not None:
+                # Final snapshot (then the socket): the run's closing update
+                # index reaches the aggregator even on early exit.
+                self._emit_telemetry(telem_reg, telem_pub, timer, idx)
+                telem_pub.close()
+            if tracer is not None and tracer.n_recorded:
+                tracer.dump(os.path.join(cfg.result_dir, "trace.json"))
             pub.close()
             writer.close()
 
@@ -525,17 +590,28 @@ class LearnerService:
     def _assemble_device(self, raws: list):
         """Assemble + eager device placement with the step's input sharding,
         so the H2D transfer happens feed-side (overlapped under prefetch)
-        instead of inside the jitted call's implicit transfer."""
+        instead of inside the jitted call's implicit transfer. Runs on the
+        feeder thread under prefetch — its trace spans land on the "feeder"
+        lane, where the overlap with the main lane's train-step is visible."""
         import jax
 
+        tracer = self._tracer
+        t0 = time.perf_counter()
         batch = self._assemble(raws)
+        t1 = time.perf_counter()
+        if tracer is not None:
+            tracer.add("assemble", t0, t1 - t0, tid="feeder")
         if self._place_global is not None or self._chain_mesh is not None:
             # Already placed during assembly: host_local_batch_to_global /
             # shard_chained_batch both produce global device arrays.
             return batch
         if self._batch_sharding is not None:
-            return jax.device_put(batch, self._batch_sharding)
-        return jax.device_put(batch, self._device)
+            placed = jax.device_put(batch, self._batch_sharding)
+        else:
+            placed = jax.device_put(batch, self._device)
+        if tracer is not None:
+            tracer.add("h2d", t1, time.perf_counter() - t1, tid="feeder")
+        return placed
 
     def _setup_multihost_feed(self, sharding) -> None:
         """On a multi-host mesh, each learner host feeds its OWN rows of the
@@ -573,47 +649,78 @@ class LearnerService:
         )
         return {"actor": jax.tree.map(jnp.copy, actor)}
 
-    def _publish(self, pub: Pub, state) -> None:
+    def _publish(self, pub: Pub, state, ver: int = -1) -> None:
         """Ship the actor tree as host numpy (SAC broadcasts the actor only,
-        reference ``sac/learning.py:145``). With the async publisher the
-        caller only snapshots + starts the D2H; the blocking device_get and
-        ZMQ send run on the publisher thread."""
+        reference ``sac/learning.py:145``), tagged with the update index
+        (``ver``) that produced it — workers echo it so storage can measure
+        policy staleness. With the async publisher the caller only snapshots
+        + starts the D2H; the blocking device_get and ZMQ send run on the
+        publisher thread."""
+        t0 = time.perf_counter()
         actor = (
             state.actor_params
             if hasattr(state, "actor_params")
             else state.params["actor"]
         )
         if self._publisher is not None:
-            self._publisher.publish(actor)
-            return
-        import jax
+            self._publisher.publish(actor, ver)
+        else:
+            import jax
 
-        pub.send(Protocol.Model, {"actor": jax.device_get(actor)})
+            pub.send(
+                Protocol.Model, {"actor": jax.device_get(actor), "ver": ver}
+            )
+        if self._tracer is not None:
+            # Async path: this span is the cheap dispatch cost the hot loop
+            # actually pays; the blocking device_get runs on the publisher
+            # thread, outside the batch timeline.
+            self._tracer.add("broadcast", t0, time.perf_counter() - t0)
+
+    def _emit_telemetry(self, reg, pub: Pub, timer: ExecutionTimer, idx: int
+                        ) -> None:
+        """Refresh the learner registry from the loop's own instruments and
+        ship one snapshot. "learner-update-index" is the authoritative policy
+        version the aggregator's staleness math ratchets on."""
+        from tpu_rl.obs import LEARNER_VERSION_GAUGE
+
+        reg.gauge(LEARNER_VERSION_GAUGE).set(idx)
+        for name, val in timer.scalars().items():
+            reg.gauge(name).set(val)
+        svc = self._inference
+        if svc is not None:
+            reg.counter("inference-requests").set_total(svc.n_requests)
+            reg.counter("inference-replies").set_total(svc.n_replies)
+            reg.counter("inference-batches").set_total(svc.n_batches)
+        pub.send(Protocol.Telemetry, reg.snapshot())
 
     def _log_fleet_stat(self, logger: LearnerLogger) -> None:
         """Consume the stat mailbox if storage activated it (reference
         ``agents/learner.py:136-148``)."""
         sa = self.stat_array
-        if sa is not None and sa[2] >= 1.0:
-            logger.log_stat(int(sa[0]), float(sa[1]))
-            if len(sa) > 4:
+        if sa is not None and sa[SLOT_ACTIVATE] >= 1.0:
+            logger.log_stat(int(sa[SLOT_GAME_COUNT]), float(sa[SLOT_MEAN_REW]))
+            if len(sa) > SLOT_MODEL_LOADS:
                 # Fleet-health slots (storage._relay_stat): corrupt-frame
                 # drops across every transport hop, and worker model-reload
                 # totals — exported as timer gauges so they reach the same
                 # dashboards as the loop timings.
                 self.timer.record_gauge(
-                    "transport-rejected-frames", float(sa[3])
+                    "transport-rejected-frames", float(sa[SLOT_REJECTED])
                 )
-                self.timer.record_gauge("worker-model-loads", float(sa[4]))
-            if len(sa) > 6:
+                self.timer.record_gauge(
+                    "worker-model-loads", float(sa[SLOT_MODEL_LOADS])
+                )
+            if len(sa) > SLOT_FORWARD_BYTES:
                 # Relay health (storage._relay_stat slots 5/6): frames shed
                 # by the manager's drop-oldest queue and wire bytes forwarded
                 # to storage — the fan-in path's loss and volume odometers.
-                self.timer.record_gauge("relay-dropped-frames", float(sa[5]))
                 self.timer.record_gauge(
-                    "manager-forward-bytes", float(sa[6])
+                    "relay-dropped-frames", float(sa[SLOT_RELAY_DROPPED])
                 )
-            sa[2] = 0.0
+                self.timer.record_gauge(
+                    "manager-forward-bytes", float(sa[SLOT_FORWARD_BYTES])
+                )
+            sa[SLOT_ACTIVATE] = 0.0
 
     def _stopped(self) -> bool:
         return self.stop_event is not None and self.stop_event.is_set()
@@ -630,6 +737,7 @@ def learner_main(
     publish_interval: int = 1,
     seed: int = 0,
     inference_port: int | None = None,
+    stat_port: int | None = None,
 ) -> None:
     """mp.Process target (reference ``run_learner``, ``main.py:189-226``)."""
     LearnerService(
@@ -643,4 +751,5 @@ def learner_main(
         publish_interval,
         seed,
         inference_port=inference_port,
+        stat_port=stat_port,
     ).run()

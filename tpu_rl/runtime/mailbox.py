@@ -1,0 +1,30 @@
+"""Stat-mailbox slot layout: the one shm contract between storage and learner.
+
+The mailbox is a lock-free ``mp.Array("f", STAT_SLOTS)`` created by the
+runner (reference ``main.py:324-326``): storage writes fleet aggregates,
+the learner reads them at its loss-log tick. The slot indices used to be
+magic numbers duplicated at both ends (``storage._relay_stat`` and
+``learner_service._log_fleet_stat``) — they live here now so the two sides
+cannot drift.
+
+Write protocol: storage fills the data slots FIRST and flips
+``SLOT_ACTIVATE`` last; the learner checks the flag, reads, and clears it.
+The float array has no torn reads per-slot, and the activate ordering keeps
+the learner from logging a half-updated window.
+
+The 7-slot mailbox is the REFERENCE-PARITY path (the first three slots are
+the reference's 3-float mailbox). The telemetry plane (``tpu_rl.obs``)
+supersedes it in expressiveness but rides beside it, never replaces it.
+"""
+
+from __future__ import annotations
+
+SLOT_GAME_COUNT = 0  # fleet global episode count
+SLOT_MEAN_REW = 1  # windowed (STAT_WINDOW-episode) mean reward
+SLOT_ACTIVATE = 2  # storage sets 1.0 after a write; learner clears
+SLOT_REJECTED = 3  # corrupt-frame drops across every transport hop
+SLOT_MODEL_LOADS = 4  # fleet total worker model reloads
+SLOT_RELAY_DROPPED = 5  # manager drop-oldest evictions
+SLOT_FORWARD_BYTES = 6  # manager -> storage forwarded wire bytes
+
+STAT_SLOTS = 7

@@ -82,9 +82,38 @@ class Manager:
         sub = self._sub = Sub("*", self.worker_port, bind=True)
         pub = Pub(*self.learner_addr, bind=False)
         recv = sub.recv_raw if self.raw else sub.recv
+
+        # Telemetry (tpu_rl.obs): the relay's own health snapshot, emitted
+        # on the clock onto the storage-bound PUB. None when the plane has
+        # no sink — the loop then pays one `is None` check per iteration.
+        registry = emitter = None
+        if self.cfg.telemetry_enabled:
+            from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+
+            registry = MetricsRegistry(role="manager")
+            emitter = PeriodicSnapshot(
+                registry,
+                lambda snap: pub.send(Protocol.Telemetry, snap),
+                interval_s=self.cfg.telemetry_interval_s,
+            )
         try:
             while not self._stopped():
                 moved = self._pump(sub, pub)
+                if registry is not None:
+                    registry.counter("manager-forwarded-frames").set_total(
+                        self.n_forwarded
+                    )
+                    registry.counter("manager-forward-bytes").set_total(
+                        self.n_forward_bytes
+                    )
+                    registry.counter("manager-dropped-frames").set_total(
+                        self.n_dropped
+                    )
+                    registry.counter("manager-stats-seen").set_total(
+                        self.n_stats
+                    )
+                    registry.gauge("manager-queue-depth").set(len(self.queue))
+                    emitter.maybe_emit()
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
                 if not moved:
@@ -114,11 +143,13 @@ class Manager:
     def _ingest(self, proto: Protocol, item, pub: Pub) -> None:
         """One received message. ``item`` is the opaque wire-parts list in
         raw mode, the decoded payload in decode mode."""
-        if proto in (Protocol.Rollout, Protocol.RolloutBatch):
+        if proto in (Protocol.Rollout, Protocol.RolloutBatch, Protocol.Telemetry):
             # Relay a RolloutBatch as one frame — never unpacked into
             # per-step messages. Drop-oldest granularity is one frame: a
             # whole tick for batched workers, exactly the steps that are
-            # most stale together.
+            # most stale together. Telemetry snapshots take the same path:
+            # tiny frames, forwarded verbatim in raw mode (the aggregator at
+            # the storage edge is their consumer, not this relay).
             parts = item if self.raw else encode(proto, item)
             if len(self.queue) == self.queue.maxlen:
                 # deque(maxlen) evicts silently; count the shed frame so the

@@ -270,6 +270,12 @@ class Protocol(enum.IntEnum):
     # carry rows for store_carry families — the learner trains from them,
     # so they must reach the RolloutBatch the worker publishes).
     Act = 5
+    # Periodic MetricsRegistry snapshot (tpu_rl.obs): every role ships its
+    # counters/gauges/histograms as one tiny labeled frame on the stat
+    # channel. The manager FORWARDS these like rollout frames (verbatim
+    # parts in raw relay mode — peek routes on the proto byte); the storage
+    # edge decodes and feeds the TelemetryAggregator.
+    Telemetry = 6
 
 
 class Codec(enum.IntEnum):

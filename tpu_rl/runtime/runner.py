@@ -35,7 +35,7 @@ from typing import Any, Callable
 from tpu_rl.config import Config, MachinesConfig
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import alloc_handles
-from tpu_rl.runtime.storage import STAT_SLOTS
+from tpu_rl.runtime.mailbox import STAT_SLOTS
 
 HEARTBEAT_TIMEOUT = 60.0  # seconds of silence before a child is declared dead
 STARTUP_GRACE = 180.0  # extra silence allowed after (re)start: jax import +
@@ -248,6 +248,9 @@ def learner_role(
             inference_port=(
                 machines.inference_port if cfg.act_mode == "remote" else None
             ),
+            # The stat channel storage SUB-binds: the learner's Telemetry
+            # snapshots ship there (LearnerService gates on telemetry_enabled).
+            stat_port=machines.learner_port,
         ),
         cfg,
         handles,

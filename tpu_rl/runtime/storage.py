@@ -20,22 +20,29 @@ write per field). ``Config.relay_mode="decode"`` keeps the per-step
 
 from __future__ import annotations
 
+import os
 import time
 
 from tpu_rl.config import Config
 from tpu_rl.data.assembler import RolloutAssembler, split_rollout_batch
 from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import ShmHandles, make_store
+from tpu_rl.runtime.mailbox import (
+    SLOT_ACTIVATE,
+    SLOT_FORWARD_BYTES,
+    SLOT_GAME_COUNT,
+    SLOT_MEAN_REW,
+    SLOT_MODEL_LOADS,
+    SLOT_REJECTED,
+    SLOT_RELAY_DROPPED,
+    STAT_SLOTS,
+)
 from tpu_rl.runtime.protocol import Protocol
 from tpu_rl.runtime.transport import Sub
 
-# [game_count, mean_rew, activate, rejected_frames, model_loads,
-#  relay_dropped, forward_bytes] — the first three are the reference's 3-float
-# mailbox (``main.py:324-326``); the fleet health slots (transport
-# corrupt-frame drops, worker model reloads — ISSUE 2, and the manager's
-# drop-oldest evictions + forwarded wire bytes — ISSUE 3) ride the same
-# activate flag and become learner timer gauges.
-STAT_SLOTS = 7
+# Slot layout lives in tpu_rl.runtime.mailbox (shared with the learner's
+# reader); STAT_SLOTS is re-exported here for existing importers.
+__all__ = ["LearnerStorage", "STAT_SLOTS", "storage_main"]
 
 
 class LearnerStorage:
@@ -58,6 +65,14 @@ class LearnerStorage:
         self.n_windows = 0
         self.n_requeue_full = 0  # windows requeued because the store was full
         self._sub: Sub | None = None
+        # Telemetry plane (tpu_rl.obs): the aggregator lives HERE — storage
+        # is the learner-side edge of the stat channel, the one hop every
+        # role's snapshots already reach. None when disabled; every call
+        # site guards on that, so the off state costs one check per frame.
+        self.aggregator = None
+        self._http = None
+        self._json_exp = None
+        self._tb_exp = None
 
     def run(self) -> None:
         cfg = self.cfg
@@ -65,6 +80,7 @@ class LearnerStorage:
         assembler = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
         store = make_store(cfg, layout, handles=self.handles)
         sub = self._sub = Sub("*", self.learner_port, bind=True)
+        self._setup_telemetry()
         try:
             while not self._stopped():
                 msg = sub.recv(timeout_ms=50)
@@ -73,15 +89,87 @@ class LearnerStorage:
                 for proto, payload in sub.drain():
                     self._ingest(proto, payload, assembler)
                 self._flush(assembler, store)
+                if self.aggregator is not None:
+                    self._telemetry_tick()
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
         finally:
             sub.close()
+            self._close_telemetry()
+
+    # ------------------------------------------------------------- telemetry
+    def _setup_telemetry(self) -> None:
+        """Construct the aggregator + exporters iff the plane has a sink
+        (``Config.telemetry_enabled``); otherwise everything stays None and
+        the ingest/tick paths reduce to a single ``is None`` check."""
+        cfg = self.cfg
+        if not cfg.telemetry_enabled:
+            return
+        from tpu_rl.obs import (
+            JsonExporter,
+            MetricsRegistry,
+            TelemetryAggregator,
+            TelemetryHTTPServer,
+            TensorboardExporter,
+        )
+        from tpu_rl.utils.metrics import NullWriter, make_writer
+
+        self.aggregator = TelemetryAggregator(
+            registry=MetricsRegistry(role="storage"),
+            stale_after_s=cfg.telemetry_stale_s,
+        )
+        if cfg.telemetry_port > 0:
+            self._http = TelemetryHTTPServer(self.aggregator, cfg.telemetry_port)
+        if cfg.result_dir is not None:
+            self._json_exp = JsonExporter(
+                self.aggregator,
+                os.path.join(cfg.result_dir, "telemetry.json"),
+                interval_s=cfg.telemetry_interval_s,
+            )
+            writer = make_writer(os.path.join(cfg.result_dir, "telemetry"))
+            if not isinstance(writer, NullWriter):
+                # Fleet health next to the loss curves; rides the JSON
+                # exporter's cadence (no writer of its own clock). Skipped
+                # when tensorboardX is absent — the JSON file still lands.
+                self._tb_exp = TensorboardExporter(writer)
+
+    def _telemetry_tick(self) -> None:
+        reg = self.aggregator.registry
+        reg.counter("storage-windows").set_total(self.n_windows)
+        reg.counter("storage-requeue-full").set_total(self.n_requeue_full)
+        reg.counter("storage-rejected-frames").set_total(
+            self._sub.n_rejected if self._sub is not None else 0
+        )
+        reg.counter("storage-telemetry-ingested").set_total(
+            self.aggregator.n_ingested
+        )
+        reg.gauge("storage-game-count").set(self.game_count)
+        if self._json_exp is not None and self._json_exp.maybe_export():
+            if self._tb_exp is not None:
+                self._tb_exp.export(self.aggregator)
+
+    def _close_telemetry(self) -> None:
+        if self._http is not None:
+            self._http.close()
+        if self._json_exp is not None:
+            self._json_exp.maybe_export(now=float("inf"))  # final snapshot
+        if self._tb_exp is not None:
+            self._tb_exp.export(self.aggregator)
+            self._tb_exp.close()
 
     def _ingest(self, proto: Protocol, payload, assembler) -> None:
         if proto == Protocol.Rollout:
             assembler.push(payload)
         elif proto == Protocol.RolloutBatch:
+            if self.aggregator is not None and isinstance(payload, dict):
+                # Policy-staleness echo (tagged on Model broadcasts, echoed
+                # by workers): how many updates behind was the policy this
+                # tick was acted with?
+                ver = payload.get("ver")
+                if isinstance(ver, int):
+                    self.aggregator.observe_staleness(
+                        int(payload.get("wid", -1)), ver
+                    )
             # One worker tick, all envs stacked: unpack at the storage edge
             # (the only hop that needs per-step granularity — the assembler
             # keys on episode id).
@@ -94,6 +182,9 @@ class LearnerStorage:
                 assembler.push_tick(payload)
         elif proto == Protocol.Stat:
             self._relay_stat(payload)
+        elif proto == Protocol.Telemetry:
+            if self.aggregator is not None:
+                self.aggregator.ingest(payload)
 
     def _flush(self, assembler: RolloutAssembler, store) -> None:
         windows = assembler.pop_many()
@@ -117,9 +208,9 @@ class LearnerStorage:
         mean = float(payload["mean"]) if isinstance(payload, dict) else float(payload)
         n = int(payload.get("n", 1)) if isinstance(payload, dict) else 1
         self.game_count += n
-        self.stat_array[0] = float(self.game_count)
-        self.stat_array[1] = mean
-        if len(self.stat_array) > 4:
+        self.stat_array[SLOT_GAME_COUNT] = float(self.game_count)
+        self.stat_array[SLOT_MEAN_REW] = mean
+        if len(self.stat_array) > SLOT_MODEL_LOADS:
             # Fleet health: manager-relayed totals (worker model-SUB drops +
             # the relay's own) plus THIS sub's corrupt-frame count — every
             # transport hop is covered. Written before the activate flag so
@@ -129,17 +220,21 @@ class LearnerStorage:
                 float(payload.get("rejected", 0.0))
                 if isinstance(payload, dict) else 0.0
             )
-            self.stat_array[3] = relayed + own
-            self.stat_array[4] = (
+            self.stat_array[SLOT_REJECTED] = relayed + own
+            self.stat_array[SLOT_MODEL_LOADS] = (
                 float(payload.get("model_loads", 0.0))
                 if isinstance(payload, dict) else 0.0
             )
-        if len(self.stat_array) > 6 and isinstance(payload, dict):
+        if len(self.stat_array) > SLOT_FORWARD_BYTES and isinstance(payload, dict):
             # Relay health (ISSUE 3): manager drop-oldest evictions and
             # forwarded wire bytes -> learner gauges.
-            self.stat_array[5] = float(payload.get("relay_dropped", 0.0))
-            self.stat_array[6] = float(payload.get("forward_bytes", 0.0))
-        self.stat_array[2] = 1.0  # activate flag; learner clears it
+            self.stat_array[SLOT_RELAY_DROPPED] = float(
+                payload.get("relay_dropped", 0.0)
+            )
+            self.stat_array[SLOT_FORWARD_BYTES] = float(
+                payload.get("forward_bytes", 0.0)
+            )
+        self.stat_array[SLOT_ACTIVATE] = 1.0  # activate flag; learner clears it
 
     def _stopped(self) -> bool:
         return self.stop_event is not None and self.stop_event.is_set()

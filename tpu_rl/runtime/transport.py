@@ -12,7 +12,10 @@ join/leave freely (SURVEY.md §5.3).
 The DCN topology (SURVEY.md §1 "physical process topology"):
 
 - rollout/stat channel: worker PUB -> manager SUB (bind) -> manager PUB ->
-  storage SUB (bind);
+  storage SUB (bind). ``Protocol.Telemetry`` snapshots (tpu_rl.obs) ride
+  this channel too: worker/manager frames fan in through the relay, and the
+  learner process publishes its own snapshots straight onto the storage
+  SUB over a loopback PUB — no extra port, no new socket pattern;
 - model channel: learner PUB (bind) -> every worker SUB, on ``model_port =
   learner_port + 1`` — the broadcast bypasses managers.
 

@@ -85,6 +85,26 @@ class Worker:
         pub = Pub(manager_ip, manager_port, bind=False)
         model_sub = Sub(learner_ip, model_port, bind=False, hwm=MODEL_HWM)
 
+        # Telemetry (tpu_rl.obs): periodic registry snapshots ride the same
+        # PUB as rollouts/stats, emitted on the CLOCK — an idle or wedged
+        # worker keeps announcing itself to /healthz. Disabled (None) when
+        # the plane has no sink, so the tick loop pays one `is None` check.
+        registry = emitter = None
+        if cfg.telemetry_enabled:
+            from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+
+            registry = MetricsRegistry(
+                role="worker", labels={"wid": str(self.worker_id)}
+            )
+
+            def _send_snap(snap, _wid=self.worker_id):
+                snap["wid"] = _wid  # aggregator source key + UI grouping
+                pub.send(Protocol.Telemetry, snap)
+
+            emitter = PeriodicSnapshot(
+                registry, _send_snap, interval_s=cfg.telemetry_interval_s
+            )
+
         family = build_family(cfg)
         key = jax.random.key(self.seed * 9973 + self.worker_id)
         if self.initial_params is not None:
@@ -137,6 +157,12 @@ class Worker:
         epi_rew = np.zeros(n, np.float64)
         epi_steps = np.zeros(n, np.int64)
         n_model_loads = 0
+        # Policy version = the learner update index tagged onto the frame
+        # that delivered the params this tick acts with ("ver" on Model
+        # broadcasts and inference Act replies). Echoed into every
+        # RolloutBatch so storage can measure policy staleness per worker;
+        # -1 = still on local random init (never broadcast-loaded).
+        policy_ver = -1
 
         try:
             while not self._stopped():
@@ -145,6 +171,7 @@ class Worker:
                 for proto, payload in model_sub.drain(max_msgs=MODEL_HWM):
                     if proto == Protocol.Model:
                         params = {"actor": payload["actor"]}
+                        policy_ver = int(payload.get("ver", -1))
                         n_model_loads += 1
 
                 reply = remote.act(obs, is_fir) if remote is not None else None
@@ -236,6 +263,16 @@ class Worker:
                         obs[i] = env.reset()
                         episode_ids[i] = uuid.uuid4().hex
                         is_fir[i], epi_rew[i], epi_steps[i] = 1.0, 0.0, 0
+                # Version echo: remote ticks acted with the server's params
+                # (the reply says which update produced them); local ticks
+                # acted with the last broadcast. Extra keys are ignored by
+                # the assembler (it reads only the batch fields + id/done),
+                # so pre-upgrade consumers are unaffected.
+                tick_ver = (
+                    int(reply.get("ver", policy_ver))
+                    if reply is not None
+                    else policy_ver
+                )
                 pub.send(
                     Protocol.RolloutBatch,
                     dict(
@@ -249,6 +286,8 @@ class Worker:
                         cx=c_np if family.store_carry else cx_stub,
                         id=tick_ids,
                         done=dones,
+                        wid=self.worker_id,
+                        ver=tick_ver,
                     ),
                 )
 
@@ -265,6 +304,22 @@ class Worker:
                     else:
                         h, c = h2, c2
 
+                if registry is not None:
+                    registry.counter("worker-env-steps").inc(n)
+                    registry.counter("worker-ticks").inc()
+                    if dones.any():
+                        registry.counter("worker-episodes").inc(
+                            int(dones.sum())
+                        )
+                    registry.gauge("worker-policy-version").set(tick_ver)
+                    registry.counter("worker-model-loads").set_total(
+                        n_model_loads
+                    )
+                    registry.counter("worker-rejected-frames").set_total(
+                        model_sub.n_rejected
+                        + (remote.n_rejected if remote else remote_rejected)
+                    )
+                    emitter.maybe_emit()
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
                 if cfg.worker_step_sleep > 0:

@@ -9,6 +9,7 @@ produces; a no-op writer keeps headless/test runs dependency-quiet.
 
 from __future__ import annotations
 
+import sys
 from typing import Mapping
 
 
@@ -20,14 +21,30 @@ class NullWriter:
     def close(self) -> None: ...
 
 
+_warned_no_tensorboard = False
+
+
 def make_writer(result_dir: str | None):
+    global _warned_no_tensorboard
     if result_dir is None:
         return NullWriter()
     try:
         from tensorboardX import SummaryWriter
 
         return SummaryWriter(result_dir)
-    except Exception:
+    except Exception as e:
+        # A result_dir was requested but no event files will appear — say
+        # why, once, instead of silently degrading (the "where are my
+        # dashboards" failure used to be undiagnosable).
+        if not _warned_no_tensorboard:
+            _warned_no_tensorboard = True
+            print(
+                f"[metrics] tensorboardX unavailable "
+                f"({type(e).__name__}: {e}); writing no event files "
+                f"(NullWriter) for result_dir={result_dir!r}",
+                file=sys.stderr,
+                flush=True,
+            )
         return NullWriter()
 
 
