@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint format-check test relay-smoke obs-smoke ci
+.PHONY: lint format-check test relay-smoke obs-smoke trace-smoke ci
 
 lint:
 	ruff check .
@@ -28,4 +28,10 @@ relay-smoke:
 obs-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/obs_smoke.py
 
-ci: lint test relay-smoke obs-smoke
+# Distributed-tracing smoke: cluster run with rollout lineage sampling on,
+# then validate the merged fleet_trace.json — all four roles on one
+# clock-corrected timeline, >=1 worker->manager->storage->learner flow chain.
+trace-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/trace_smoke.py
+
+ci: lint test relay-smoke obs-smoke trace-smoke

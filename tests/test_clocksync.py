@@ -1,0 +1,201 @@
+"""Clock-offset estimator tests (tpu_rl.obs.clocksync, ISSUE 5 satellite):
+synthetic two-clock fixtures with known skew/drift/latency so every estimate
+can be checked against ground truth — in particular that the TRUE offset
+always lies within the reported uncertainty, including the asymmetric-latency
+worst case where the NTP midpoint is maximally wrong.
+"""
+
+import pytest
+
+from tpu_rl.obs.clocksync import (
+    DRIFT_PPM,
+    MIN_UNCERTAINTY_NS,
+    ONE_WAY_FLOOR_NS,
+    ClockEstimate,
+    ClockSync,
+)
+
+MS = 1_000_000  # ns
+
+
+class TwoClocks:
+    """Deterministic reference + remote clock pair. The remote reads
+    ``ref * (1 + drift_ppm*1e-6) + offset_ns``. Exchanges advance the
+    reference clock explicitly — no wall-clock dependence anywhere."""
+
+    def __init__(self, offset_ns: int, drift_ppm: float = 0.0):
+        self.offset_ns = offset_ns
+        self.drift_ppm = drift_ppm
+        self.ref_ns = 1_000_000_000_000  # arbitrary epoch
+
+    def remote(self, ref_ns: int) -> int:
+        return int(ref_ns * (1.0 + self.drift_ppm * 1e-6)) + self.offset_ns
+
+    def true_offset_at(self, ref_ns: int) -> int:
+        return self.remote(ref_ns) - ref_ns
+
+    def exchange(self, d_out_ns: int, d_back_ns: int, proc_ns: int = 0):
+        """One NTP round trip: reference -> remote (d_out), remote holds the
+        echo for proc_ns, remote -> reference (d_back). Returns t0..t3."""
+        t0 = self.ref_ns
+        t1 = self.remote(t0 + d_out_ns)
+        t2 = self.remote(t0 + d_out_ns + proc_ns)
+        t3 = t0 + d_out_ns + proc_ns + d_back_ns
+        self.ref_ns = t3 + MS  # next exchange starts 1 ms later
+        return t0, t1, t2, t3
+
+
+def _sync(clocks: TwoClocks) -> ClockSync:
+    # The estimator's own age clock is the reference clock — deterministic.
+    return ClockSync(clock=lambda: clocks.ref_ns)
+
+
+# ------------------------------------------------------------------ rtt
+def test_symmetric_latency_recovers_offset_exactly():
+    clocks = TwoClocks(offset_ns=250 * MS)
+    cs = _sync(clocks)
+    for _ in range(8):
+        cs.add_round_trip("w", *clocks.exchange(2 * MS, 2 * MS, proc_ns=MS))
+    est = cs.estimate("w")
+    assert est is not None and est.kind == "rtt" and est.n_samples == 8
+    # Symmetric paths: the NTP midpoint IS the offset.
+    assert abs(est.offset_ns - 250 * MS) <= MIN_UNCERTAINTY_NS
+    assert abs(est.offset_ns - 250 * MS) <= est.uncertainty_ns
+
+
+@pytest.mark.parametrize("offset_ms", [-5000, -1, 0, 1, 7, 5000])
+def test_true_offset_within_uncertainty_across_skews(offset_ms):
+    clocks = TwoClocks(offset_ns=offset_ms * MS)
+    cs = _sync(clocks)
+    # Jittery but symmetric-on-average delays (deterministic pattern).
+    for i in range(16):
+        d = (1 + (i * 7) % 5) * MS
+        cs.add_round_trip("w", *clocks.exchange(d, d, proc_ns=MS // 2))
+    est = cs.estimate("w")
+    true = clocks.true_offset_at(clocks.ref_ns)
+    assert abs(est.offset_ns - true) <= est.uncertainty_ns
+
+
+def test_asymmetric_latency_worst_case_covered_by_delay_bound():
+    """d_out=5ms, d_back=0: the midpoint is off by exactly delay/2 = 2.5ms —
+    the theoretical worst case. The reported uncertainty must cover it (the
+    delay/2 term exists for precisely this)."""
+    clocks = TwoClocks(offset_ns=100 * MS)
+    cs = _sync(clocks)
+    cs.add_round_trip("w", *clocks.exchange(5 * MS, 0))
+    est = cs.estimate("w")
+    err = abs(est.offset_ns - 100 * MS)
+    # midpoint error = (d_back - d_out)/2 = -2.5ms
+    assert err == pytest.approx(2.5 * MS, abs=MIN_UNCERTAINTY_NS)
+    assert err <= est.uncertainty_ns
+    # ...and the bound is tight-ish: delay/2 + floor, not an order worse.
+    assert est.uncertainty_ns <= 5 * MS // 2 + 2 * MIN_UNCERTAINTY_NS
+
+
+def test_min_delay_filter_prefers_clean_sample():
+    """One queue-spiked exchange (40ms out / 0 back) among clean 1ms ones:
+    the clock filter must pick a clean sample, keeping the error small even
+    though the spiked sample alone would be off by 20ms."""
+    clocks = TwoClocks(offset_ns=-30 * MS)
+    cs = _sync(clocks)
+    cs.add_round_trip("w", *clocks.exchange(40 * MS, 0))
+    for _ in range(6):
+        cs.add_round_trip("w", *clocks.exchange(MS, MS))
+    est = cs.estimate("w")
+    assert abs(est.offset_ns - (-30 * MS)) <= 2 * MIN_UNCERTAINTY_NS
+    assert abs(est.offset_ns - (-30 * MS)) <= est.uncertainty_ns
+
+
+def test_drift_grows_uncertainty_with_age():
+    """A drifting remote crystal: the true offset moves after the last
+    sample, and the drift allowance in the aging bound must keep covering
+    it (DRIFT_PPM is deliberately above the simulated 50 ppm)."""
+    clocks = TwoClocks(offset_ns=10 * MS, drift_ppm=50.0)
+    cs = _sync(clocks)
+    for _ in range(4):
+        cs.add_round_trip("w", *clocks.exchange(MS, MS))
+    est_fresh = cs.estimate("w")
+    true_fresh = clocks.true_offset_at(clocks.ref_ns)
+    assert abs(est_fresh.offset_ns - true_fresh) <= est_fresh.uncertainty_ns
+    # 60 reference-seconds pass with no new samples: the remote clock has
+    # drifted 50ppm * 60s = 3ms away from the last estimate.
+    clocks.ref_ns += 60 * 1_000_000_000
+    est_old = cs.estimate("w")
+    true_old = clocks.true_offset_at(clocks.ref_ns)
+    assert est_old.offset_ns == est_fresh.offset_ns  # same winning sample
+    assert est_old.uncertainty_ns > est_fresh.uncertainty_ns
+    assert est_old.age_s == pytest.approx(60.0, abs=1.0)
+    assert abs(est_old.offset_ns - true_old) <= est_old.uncertainty_ns
+    assert DRIFT_PPM > 50.0  # the guarantee above relies on this margin
+
+
+def test_negative_delay_clamped_not_dropped():
+    # A stepped clock mid-exchange can produce t2-t1 > t3-t0; the sample is
+    # kept with zero delay credit rather than raising or vanishing.
+    cs = ClockSync(clock=lambda: 0)
+    cs.add_round_trip("w", t0=100, t1=500, t2=900, t3=200)
+    est = cs.estimate("w")
+    assert est is not None and est.n_samples == 1
+
+
+# ------------------------------------------------------------------ one-way
+def test_one_way_is_lower_bound_with_wide_floor():
+    """Manager path: only t_tx/t_rx pairs. Every sample reads offset-delay,
+    so the max over the window is a LOWER bound on the true offset; the
+    estimate must flag itself one-way and report >= the floor uncertainty."""
+    clocks = TwoClocks(offset_ns=80 * MS)
+    cs = _sync(clocks)
+    for i in range(8):
+        d = (1 + i % 3) * MS
+        t_tx = clocks.remote(clocks.ref_ns)
+        t_rx = clocks.ref_ns + d
+        cs.add_one_way("m", t_tx, t_rx)
+        clocks.ref_ns = t_rx + MS
+    est = cs.estimate("m")
+    assert est.kind == "one-way"
+    assert est.offset_ns <= 80 * MS  # never overshoots the truth
+    assert est.offset_ns >= 80 * MS - 3 * MS  # within the worst delay seen
+    assert est.uncertainty_ns >= ONE_WAY_FLOOR_NS
+    assert abs(est.offset_ns - 80 * MS) <= est.uncertainty_ns
+
+
+def test_rtt_samples_preferred_over_one_way():
+    cs = ClockSync(clock=lambda: 0)
+    cs.add_one_way("w", t_tx=0, t_rx=1000)
+    cs.add_round_trip("w", t0=0, t1=500, t2=500, t3=1000)
+    est = cs.estimate("w")
+    assert est.kind == "rtt" and est.n_samples == 1  # rtt count only
+
+
+# ---------------------------------------------------------------- plumbing
+def test_estimate_unknown_key_is_none():
+    cs = ClockSync()
+    assert cs.estimate("nope") is None
+    assert cs.snapshot() == {}
+
+
+def test_window_bounds_memory():
+    cs = ClockSync(window=4, clock=lambda: 0)
+    for i in range(100):
+        cs.add_round_trip("w", 0, 10, 10, 20)
+    assert cs.estimate("w").n_samples == 4
+    assert cs.n_samples == 100
+
+
+def test_snapshot_schema_json_ready():
+    import json
+
+    clocks = TwoClocks(offset_ns=MS)
+    cs = _sync(clocks)
+    cs.add_round_trip("worker/h/1", *clocks.exchange(MS, MS))
+    t_tx = clocks.remote(clocks.ref_ns)
+    cs.add_one_way("manager/h/2", t_tx, clocks.ref_ns + MS)
+    snap = cs.snapshot()
+    assert set(snap) == {"worker/h/1", "manager/h/2"}
+    for v in snap.values():
+        assert set(v) == {
+            "offset_ns", "uncertainty_ns", "n_samples", "kind", "age_s"
+        }
+    json.dumps(snap)  # embeds into trace meta as-is
+    assert snap["worker/h/1"]["kind"] == "rtt"
+    assert snap["manager/h/2"]["kind"] == "one-way"

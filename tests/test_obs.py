@@ -244,7 +244,7 @@ def test_trace_chrome_schema(tmp_path):
     with tr.span("train-step"):
         pass
     doc = tr.to_chrome()
-    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "meta"}
     events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
     metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
     assert [e["name"] for e in events] == ["assemble", "train-step"]
@@ -263,6 +263,32 @@ def test_trace_chrome_schema(tmp_path):
     tr.dump(str(path))
     loaded = json.loads(path.read_text())  # valid JSON on disk
     assert loaded["displayTimeUnit"] == "ms"
+
+
+def test_trace_meta_anchors_and_role_identity(tmp_path):
+    """ISSUE 5 satellite: every dump carries the merge anchor (wall_anchor_ns
+    paired with the perf_counter epoch) plus role/pid/host identity and a
+    process_name metadata event — without these a ring can't be placed on
+    the fleet timeline."""
+    tr = TraceRecorder(capacity=8, pid=77, role="storage", host="box9")
+    tr.add("storage-ingest", 0.0, 0.001, args={"trace_id": 5})
+    doc = tr.to_chrome(extra_meta={"clock": {"worker/h/1": {"offset_ns": 3}}})
+    meta = doc["meta"]
+    assert meta["role"] == "storage" and meta["pid"] == 77
+    assert meta["host"] == "box9"
+    assert isinstance(meta["wall_anchor_ns"], int)
+    assert meta["clock"] == {"worker/h/1": {"offset_ns": 3}}  # extra merged
+    pnames = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    ]
+    assert [p["args"]["name"] for p in pnames] == ["storage box9/77"]
+    # span args (the lineage tag) survive the export
+    (ev,) = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert ev["args"] == {"trace_id": 5}
+    path = tmp_path / "t.json"
+    tr.dump(str(path), extra_meta={"clock": {}})
+    assert json.loads(path.read_text())["meta"]["clock"] == {}
 
 
 # ------------------------------------------------------------ json exporter
@@ -320,3 +346,342 @@ def test_enabled_telemetry_gate():
     assert small_config(result_dir="/tmp/x").telemetry_enabled
     agg = maybe_aggregator(small_config(telemetry_port=18123))
     assert isinstance(agg, TelemetryAggregator)
+
+
+def test_sampling_off_trace_path_allocates_nothing():
+    """ISSUE 5 acceptance pin: with trace_sample_n=0 the storage ingest path
+    for UNSAMPLED RolloutBatch frames (trailer=None) allocates nothing in
+    storage.py even when a tracer exists — the guard is one `is None` pair.
+    The assembler's own data-plane writes are its job, not tracing cost."""
+    import numpy as np
+
+    from tpu_rl.data.assembler import RolloutAssembler
+    from tpu_rl.data.layout import BatchLayout
+    from tpu_rl.runtime.storage import LearnerStorage
+    from tpu_rl.types import BATCH_FIELDS
+
+    cfg = small_config(telemetry_port=0, result_dir=None, relay_mode="raw")
+    st = LearnerStorage(cfg, handles=None, learner_port=0)
+    st._tracer = TraceRecorder(capacity=64, pid=1, role="storage", host="h")
+    layout = BatchLayout.from_config(cfg)
+    asm = RolloutAssembler(layout, lag_sec=1e9)
+    payload = {
+        f: np.zeros((2, layout.width(f)), dtype=np.float32)
+        for f in BATCH_FIELDS
+    }
+    payload["id"] = ["e0", "e1"]
+    payload["done"] = np.zeros(2, dtype=np.uint8)
+    for _ in range(64):
+        st._ingest(Protocol.RolloutBatch, payload, asm)
+    tracemalloc.start()
+    snap1 = tracemalloc.take_snapshot()
+    for _ in range(256):
+        st._ingest(Protocol.RolloutBatch, payload, asm)
+    snap2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    here = [
+        s
+        for s in snap2.compare_to(snap1, "lineno")
+        if s.traceback[0].filename.endswith("storage.py") and s.size_diff > 0
+    ]
+    assert here == [], [str(s) for s in here]
+    assert st._tracer.n_recorded == 0  # nothing sampled -> nothing recorded
+
+
+def test_sampling_off_manager_relay_allocates_nothing():
+    """Same pin at the relay: ingesting untraced (2-part) frames with a
+    tracer present costs one length check, zero allocations in manager.py.
+    The queue is prefilled past capacity so deque block growth and the
+    beyond-small-int drop counter are steady-state before measuring."""
+    from tpu_rl.runtime.manager import Manager
+
+    cfg = small_config(relay_mode="raw")
+    m = Manager(cfg, 0, "127.0.0.1", 0)
+    m._tracer = TraceRecorder(capacity=64, pid=1, role="manager", host="h")
+
+    class _NullPub:
+        def send_raw(self, parts):
+            pass
+
+    pub = _NullPub()
+    parts = encode(Protocol.RolloutBatch, {"x": 1})
+    # Warm past the deque's maxlen AND past CPython's small-int cache (256)
+    # so n_dropped's live int object is steady-state; the warm runs INSIDE
+    # the tracing window so that int's allocation site is tracked in BOTH
+    # snapshots (counter churn nets to zero, not to one untracked->tracked).
+    tracemalloc.start()
+    for _ in range(m.queue.maxlen + 300):
+        m._ingest(Protocol.RolloutBatch, parts, pub)
+    assert m.n_dropped > 256
+    snap1 = tracemalloc.take_snapshot()
+    for _ in range(256):
+        m._ingest(Protocol.RolloutBatch, parts, pub)
+    snap2 = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    here = [
+        s
+        for s in snap2.compare_to(snap1, "lineno")
+        if s.traceback[0].filename.endswith("manager.py") and s.size_diff > 0
+    ]
+    assert here == [], [str(s) for s in here]
+    assert m._tracer.n_recorded == 0
+
+
+# ------------------------------------------------------------ tracez server
+@pytest.mark.timeout(30)
+def test_http_exporter_tracez_endpoint():
+    agg = TelemetryAggregator()
+    tr = TraceRecorder(capacity=8, pid=9, role="storage")
+    tr.add("storage-ingest", 0.0, 0.002, args={"trace_id": 11})
+    srv = TelemetryHTTPServer(
+        agg, port=0, tracez=lambda: {"role": "storage", "trace": tr.to_chrome()}
+    )
+    try:
+        url = f"http://127.0.0.1:{srv.port}/tracez"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert r.status == 200
+            doc = json.loads(r.read())
+        assert doc["role"] == "storage"
+        names = [
+            e["name"] for e in doc["trace"]["traceEvents"] if e["ph"] == "X"
+        ]
+        assert names == ["storage-ingest"]
+    finally:
+        srv.close()
+
+
+@pytest.mark.timeout(30)
+def test_http_exporter_close_releases_port_and_is_idempotent():
+    """ISSUE 5 satellite (graceful shutdown regression): close() must join
+    the serving thread and release the socket so the SAME port can be
+    re-bound immediately — the restart-a-role-in-place case — and calling
+    close() twice must be a no-op, not an error."""
+    agg = TelemetryAggregator()
+    srv1 = TelemetryHTTPServer(agg, port=0)
+    port = srv1.port
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=5
+    ) as r:
+        assert r.status == 200
+    srv1.close()
+    srv1.close()  # idempotent
+    srv2 = TelemetryHTTPServer(agg, port=port)  # same port, fresh server
+    try:
+        assert srv2.port == port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ) as r:
+            assert r.status == 200
+    finally:
+        srv2.close()
+        srv2.close()
+
+
+# ---------------------------------------------------------- flight recorder
+def test_flightrec_dump_content_and_fingerprint(tmp_path):
+    from tpu_rl.obs import flightrec
+
+    cfg = small_config()
+    tr = TraceRecorder(capacity=8, pid=5, role="worker")
+    tr.add("worker-tick", 0.0, 0.001, args={"trace_id": 3})
+    fr = flightrec.FlightRecorder(
+        "worker", str(tmp_path), tracer=tr, cfg=cfg,
+        extra=lambda: {"queue_depth": 4},
+    )
+    path = fr.dump("unit-test")
+    assert path is not None and path.endswith(
+        f"flightrec-worker-{__import__('os').getpid()}.json"
+    )
+    doc = json.loads(open(path).read())
+    assert doc["role"] == "worker" and doc["reason"] == "unit-test"
+    assert doc["last_error"] is None
+    assert doc["extra"] == {"queue_depth": 4}
+    # fingerprint: stable per config, distinct across configs
+    assert doc["config_fingerprint"] == flightrec.config_fingerprint(cfg)
+    assert flightrec.config_fingerprint(
+        small_config(batch_size=cfg.batch_size * 2)
+    ) != doc["config_fingerprint"]
+    names = [
+        e["name"] for e in doc["trace"]["traceEvents"] if e["ph"] == "X"
+    ]
+    assert names == ["worker-tick"]
+    # without a sink, dump is a clean no-op
+    assert flightrec.FlightRecorder("w", None).dump() is None
+    # extra() raising must not kill the dump
+    boom = flightrec.FlightRecorder(
+        "w", str(tmp_path), extra=lambda: 1 / 0
+    )
+    doc2 = boom.snapshot()
+    assert "error" in doc2["extra"]
+
+
+def test_flightrec_crash_hook_via_role_entry(tmp_path):
+    """utils.errlog.role_entry: a role that installed a recorder and dies
+    leaves flightrec-<role>-<pid>.json carrying the fatal traceback."""
+    import os
+
+    from tpu_rl.obs import flightrec
+    from tpu_rl.utils.errlog import role_entry
+
+    def target():
+        flightrec.install("worker", str(tmp_path))
+        raise RuntimeError("synthetic crash")
+
+    with pytest.raises(RuntimeError, match="synthetic crash"):
+        role_entry(target, "worker", str(tmp_path / "logs"))
+    path = tmp_path / f"flightrec-worker-{os.getpid()}.json"
+    doc = json.loads(path.read_text())
+    assert doc["reason"] == "fatal-exception"
+    assert "RuntimeError: synthetic crash" in doc["last_error"]
+    assert "Traceback" in doc["last_error"]
+
+
+def test_flightrec_sigusr1_dump(tmp_path):
+    """kill -USR1 <pid> on a live process dumps without stopping it. The
+    pytest process IS the main thread, so the real handler path runs; the
+    previous handler is restored afterwards."""
+    import os
+    import signal
+    import threading
+
+    from tpu_rl.obs import flightrec
+
+    if threading.current_thread() is not threading.main_thread():
+        pytest.skip("signal install requires the main thread")
+    prev = signal.getsignal(signal.SIGUSR1)
+    try:
+        fr = flightrec.install("storage", str(tmp_path))
+        assert flightrec.current() is fr
+        os.kill(os.getpid(), signal.SIGUSR1)
+        path = tmp_path / f"flightrec-storage-{os.getpid()}.json"
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "SIGUSR1" and fr.n_dumps == 1
+    finally:
+        signal.signal(signal.SIGUSR1, prev)
+
+
+# ------------------------------------------------------------------- merge
+def _trace_doc(role, pid, anchor_ns, spans, clock=None, host="h"):
+    """Hand-built TraceRecorder dump: spans = [(name, ts_us, dur_us, args)]."""
+    meta = {"role": role, "pid": pid, "host": host, "wall_anchor_ns": anchor_ns}
+    if clock is not None:
+        meta["clock"] = clock
+    return {
+        "traceEvents": [
+            {"name": n, "ph": "X", "ts": ts, "dur": dur, "pid": pid,
+             "tid": 0, **({"args": args} if args else {})}
+            for n, ts, dur, args in spans
+        ],
+        "displayTimeUnit": "ms",
+        "meta": meta,
+    }
+
+
+def test_merge_clock_corrects_and_links_flows(tmp_path):
+    """Two processes whose wall clocks disagree by 5 s, plus a learner: the
+    merged timeline must place their spans in TRUE order (clock-corrected),
+    chain the sampled rollout's hops with flow events, and close the chain
+    onto the first train-step after window-close, flagged synthesized."""
+    from tpu_rl.obs.merge import merge_traces
+
+    R = 1_000_000_000_000  # reference epoch, ns
+    tid42 = 42
+    worker = _trace_doc(
+        "worker", 1, R + 5_000_000_000,  # local clock 5 s AHEAD of reference
+        [("worker-tick", 0.0, 100.0, {"trace_id": tid42, "seq": 7})],
+    )
+    storage = _trace_doc(
+        "storage", 2, R + 1_000_000,  # colocated with reference, 1 ms later
+        [
+            ("storage-ingest", 500.0, 20.0, {"trace_id": tid42}),
+            ("window-close", 600.0, 1.0, {"trace_id": tid42}),
+        ],
+        clock={"worker/h/1": {
+            "offset_ns": 5_000_000_000, "uncertainty_ns": 1000,
+            "n_samples": 4, "kind": "rtt", "age_s": 0.0,
+        }},
+    )
+    learner = _trace_doc(
+        "learner", 3, R + 2_000_000,
+        [("train-step", 0.0, 50.0, None)],
+    )
+    merged = merge_traces([worker, storage, learner])
+    assert merged["meta"]["roles"] == ["learner", "storage", "worker"]
+    assert merged["meta"]["flows"] == 1
+    xs = {e["name"]: e for e in merged["traceEvents"] if e["ph"] == "X"}
+    # Uncorrected, the worker's tick would sit 5 s in the future; corrected,
+    # it is the EARLIEST event (the normalized axis origin).
+    assert xs["worker-tick"]["ts"] == pytest.approx(0.0)
+    assert xs["storage-ingest"]["ts"] == pytest.approx(1500.0)
+    assert xs["window-close"]["ts"] == pytest.approx(1600.0)
+    assert xs["train-step"]["ts"] == pytest.approx(2000.0)
+    # docs get distinct pid lanes even if raw pids collided
+    assert len({e["pid"] for e in merged["traceEvents"] if e["ph"] == "X"}) == 3
+    flows = [e for e in merged["traceEvents"] if e.get("cat") == "lineage"]
+    assert [f["ph"] for f in flows] == ["s", "t", "t", "f"]
+    assert all(f["id"] == f"0x{tid42:x}" for f in flows)
+    assert [f["args"]["hop"] for f in flows] == [
+        "worker-tick", "storage-ingest", "window-close", "train-step"
+    ]
+    # only the synthesized learner hop is flagged; the finish binds encl.
+    assert [f["args"]["synthesized"] for f in flows] == [
+        False, False, False, True
+    ]
+    assert flows[-1]["bp"] == "e"
+    # the start anchors at its slice END (frame leaves the hop)
+    assert flows[0]["ts"] == pytest.approx(100.0)
+    json.dumps(merged)  # whole doc is valid trace-event JSON
+
+
+def test_merge_skips_unanchored_and_single_hop_chains():
+    from tpu_rl.obs.merge import merge_traces
+
+    no_anchor = {
+        "traceEvents": [{"name": "x", "ph": "X", "ts": 0, "dur": 1, "pid": 0,
+                         "tid": 0}],
+        "meta": {"role": "worker"},  # pre-anchor dump: nothing to place
+    }
+    lone = _trace_doc(
+        "worker", 1, 10**12,
+        [("worker-tick", 0.0, 1.0, {"trace_id": 9})],
+    )
+    merged = merge_traces([no_anchor, lone])
+    assert merged["meta"]["roles"] == ["worker"]
+    assert merged["meta"]["flows"] == 0  # one hop is not a chain
+    assert not [e for e in merged["traceEvents"] if e.get("cat") == "lineage"]
+    assert merge_traces([])["traceEvents"] == []
+
+
+def test_merge_result_dir_and_cli(tmp_path):
+    from tpu_rl.obs import merge_result_dir
+    from tpu_rl.obs.merge import MERGED_NAME, main
+
+    R = 10**12
+    docs = {
+        "trace-worker-1.json": _trace_doc(
+            "worker", 1, R, [("worker-tick", 0.0, 5.0, {"trace_id": 1})]
+        ),
+        "trace-storage-2.json": _trace_doc(
+            "storage", 2, R,
+            [("storage-ingest", 50.0, 5.0, {"trace_id": 1})],
+        ),
+        "trace.json": _trace_doc(  # the learner's dump name
+            "learner", 3, R, [("train-step", 100.0, 5.0, None)]
+        ),
+    }
+    for name, doc in docs.items():
+        (tmp_path / name).write_text(json.dumps(doc))
+    (tmp_path / "telemetry.json").write_text("{}")  # ignored: not a trace
+    summary = merge_result_dir(str(tmp_path))
+    assert summary["n_files"] == 3 and summary["flows"] == 1
+    assert set(summary["roles"]) == {"worker", "storage", "learner"}
+    out = json.loads((tmp_path / MERGED_NAME).read_text())
+    assert out["meta"]["flows"] == 1
+    # CLI: re-merge in place (the merged file is excluded from its own
+    # inputs), usage errors exit 2, empty dirs exit 1
+    assert main([str(tmp_path)]) == 0
+    assert main([]) == 2
+    assert main([str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main([str(empty)]) == 1

@@ -131,7 +131,10 @@ def test_cluster_telemetry_scrape_end_to_end(tmp_path):
     /metrics mid-run, and find Prometheus-parseable samples from worker,
     manager, storage AND learner — including a nonzero
     policy-staleness-updates observation — then validate /healthz,
-    result_dir/telemetry.json and the learner's Chrome trace."""
+    result_dir/telemetry.json and the learner's Chrome trace. With
+    trace_sample_n on (ISSUE 5 acceptance), the run must also leave a merged
+    fleet_trace.json whose sampled rollout chains link worker, manager,
+    storage and learner spans with Chrome flow events."""
     from tpu_rl.runtime.runner import local_cluster
 
     base, tport = 28920, 28960
@@ -142,6 +145,7 @@ def test_cluster_telemetry_scrape_end_to_end(tmp_path):
         telemetry_stale_s=120.0,  # slow CI must not flap /healthz
         result_dir=str(tmp_path / "run"),
         loss_log_interval=2,
+        trace_sample_n=2,  # every 2nd worker tick carries a trace trailer
     )
     assert cfg.telemetry_enabled
     sup = local_cluster(cfg, _machines(base), max_updates=6)
@@ -176,6 +180,12 @@ def test_cluster_telemetry_scrape_end_to_end(tmp_path):
         for role in doc["roles"].values():
             assert role["sources"] >= 1
 
+        # /tracez: the storage edge's live span ring + clock estimates.
+        status, body = _scrape(f"http://127.0.0.1:{tport}/tracez")
+        assert status == 200
+        tz = json.loads(body)
+        assert tz["role"] == "storage" and tz["trace"] is not None
+
         while time.time() < deadline and learner.proc.is_alive():
             time.sleep(1.0)
         assert not learner.proc.is_alive() and learner.proc.exitcode == 0
@@ -190,3 +200,27 @@ def test_cluster_telemetry_scrape_end_to_end(tmp_path):
     names = {ev["name"] for ev in trace["traceEvents"] if ev["ph"] == "X"}
     assert {"queue-wait", "train-step"} <= names
     assert os.path.getsize(tmp_path / "run" / "telemetry.json") > 0
+
+    # ISSUE 5 acceptance: the storage edge auto-merged the fleet trace at
+    # shutdown; re-merge now that EVERY role has joined (late final dumps)
+    # and require at least one complete clock-corrected lineage chain.
+    from tpu_rl.obs import merge_result_dir
+    from tpu_rl.obs.merge import MERGED_NAME
+
+    run = tmp_path / "run"
+    assert (run / MERGED_NAME).exists(), "storage did not auto-merge"
+    summary = merge_result_dir(str(run))
+    assert {"worker", "manager", "storage", "learner"} <= set(summary["roles"])
+    assert summary["flows"] >= 1
+    fleet = json.loads((run / MERGED_NAME).read_text())  # valid JSON on disk
+    chains: dict = {}
+    for ev in fleet["traceEvents"]:
+        if ev.get("cat") == "lineage":
+            chains.setdefault(ev["id"], []).append(ev["args"]["hop"])
+    assert any(
+        {"worker-tick", "storage-ingest", "train-step"} <= set(hops)
+        and ("relay-in" in hops or "relay-out" in hops)
+        for hops in chains.values()
+    ), f"no fully-linked rollout chain: {chains}"
+    # clock sync saw the worker (full NTP loop rides Model + Telemetry)
+    assert any(k.startswith("worker") for k in fleet["meta"]["clock"])

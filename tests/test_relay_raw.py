@@ -16,12 +16,17 @@ from tpu_rl.runtime.protocol import (
     _HEADER,
     _MAGIC,
     _MAX_RAW,
+    _TRAILER,
+    _TRAILER_MAGIC,
     _VERSION,
     Codec,
     Protocol,
     decode,
     encode,
+    make_trace_id,
+    pack_trace,
     peek,
+    unpack_trace,
 )
 from tpu_rl.runtime.transport import Pub, Sub
 
@@ -42,12 +47,18 @@ class TestPeek:
             [b"\x01"],  # missing body frame
             [b"", b"x"],  # empty proto frame
             [b"\x01\x01", b"x"],  # 2-byte proto frame
-            [b"\x01", b"x", b"y"],  # extra part
+            [b"\x01", b"x", b"y"],  # short body frame (3-part shape is legal)
         ],
     )
     def test_malformed_multipart_rejected(self, parts):
         with pytest.raises(ValueError):
             peek(parts)
+
+    def test_four_parts_rejected(self):
+        pb, body = _frame()
+        trailer = pack_trace(0, 1, make_trace_id(0, 1), 0)
+        with pytest.raises(ValueError):
+            peek([pb, body, trailer, b"extra"])
 
     def test_unknown_proto_byte_rejected(self):
         _, body = _frame()
@@ -93,6 +104,76 @@ class TestPeek:
         assert peek([pb, corrupt]) == Protocol.RolloutBatch
         with pytest.raises(ValueError):
             decode([pb, corrupt])
+
+
+class TestTrailer:
+    """Trace-context trailer (ISSUE 5 tentpole): the optional 28-byte third
+    wire part. peek/decode must tolerate a VALID trailer on rollout kinds,
+    reject it everywhere else, and reject malformed trailers at the relay
+    edge so a garbage third part can never reach storage."""
+
+    def test_pack_unpack_round_trip(self):
+        tid = make_trace_id(wid=7, seq=123456)
+        ts = 1_722_000_000_000_000_000
+        trailer = pack_trace(7, 123456, tid, ts)
+        assert len(trailer) == _TRAILER.size == 28
+        assert unpack_trace(trailer) == (7, 123456, tid, ts)
+
+    def test_trace_id_bounded_and_json_round_trips(self):
+        # 22-bit wid + 32-bit seq = 54-bit id space. The merger emits flow
+        # ids as hex STRINGS (Perfetto-safe regardless of double precision);
+        # the raw int only needs to survive a JSON text round trip exactly.
+        import json
+
+        tid = make_trace_id(wid=0x3FFFFF, seq=0xFFFFFFFF)
+        assert tid == 2**54 - 1  # full-width id stays in 54 bits
+        assert json.loads(json.dumps({"trace_id": tid}))["trace_id"] == tid
+        assert make_trace_id(3, 9) != make_trace_id(9, 3)
+
+    def test_peek_accepts_valid_trailer_on_rollout_kinds(self):
+        trailer = pack_trace(1, 2, make_trace_id(1, 2), 3)
+        for proto in (Protocol.Rollout, Protocol.RolloutBatch):
+            pb, body = _frame({"x": 1}, proto)
+            assert peek([pb, body, trailer]) == proto
+
+    def test_trailer_on_non_rollout_kinds_rejected(self):
+        trailer = pack_trace(1, 2, make_trace_id(1, 2), 3)
+        for proto in (Protocol.Stat, Protocol.Model, Protocol.Telemetry):
+            pb, body = _frame(1.5, proto)
+            with pytest.raises(ValueError):
+                peek([pb, body, trailer])
+
+    @pytest.mark.parametrize(
+        "trailer",
+        [
+            b"",  # empty
+            b"g" * 28,  # right size, garbage content
+            pack_trace(1, 2, 3, 4)[:-1],  # truncated
+            pack_trace(1, 2, 3, 4) + b"x",  # oversized
+            _TRAILER.pack(0xDEAD, 1, 1, 2, 3, 4),  # bad magic
+            _TRAILER.pack(_TRAILER_MAGIC, 99, 1, 2, 3, 4),  # bad version
+        ],
+    )
+    def test_malformed_trailer_rejected_at_peek_and_decode(self, trailer):
+        pb, body = _frame({"x": 1}, Protocol.RolloutBatch)
+        with pytest.raises(ValueError):
+            peek([pb, body, trailer])
+        with pytest.raises(ValueError):
+            decode([pb, body, trailer])
+
+    def test_decode_ignores_valid_trailer(self):
+        # decode() validates the trailer but returns only (proto, payload);
+        # lineage consumers use Sub.recv_traced for the third part.
+        trailer = pack_trace(4, 5, make_trace_id(4, 5), 6)
+        parts = encode(Protocol.RolloutBatch, {"a": 1}, trace=trailer)
+        assert len(parts) == 3 and parts[2] == trailer
+        proto, payload = decode(parts)
+        assert proto == Protocol.RolloutBatch and payload == {"a": 1}
+
+    def test_unpack_trace_rejects_garbage(self):
+        for bad in (b"", b"short", b"x" * 28, b"x" * 29):
+            with pytest.raises(ValueError):
+                unpack_trace(bad)
 
 
 @pytest.mark.timeout(60)
@@ -170,6 +251,75 @@ def test_manager_raw_relay_forwards_byte_identical_and_survives_garbage():
         sink.close()
         pub.close()
     assert not t.is_alive()
+
+
+@pytest.mark.timeout(120)
+def test_manager_raw_relay_forwards_trailer_and_survives_garbage_trailer():
+    """Sampled (3-part) frames relay byte-identically — trailer included —
+    through a real raw-mode Manager; frames with a garbage trailer are
+    rejected at peek without crashing the relay."""
+    worker_port, learner_port = 29630, 29631
+    cfg = small_config(relay_mode="raw")
+    stop = threading.Event()
+    m = Manager(cfg, worker_port, "127.0.0.1", learner_port, stop_event=stop)
+    t = threading.Thread(target=m.run, daemon=True)
+    t.start()
+    sink = Sub("*", learner_port, bind=True)
+    pub = Pub("127.0.0.1", worker_port, bind=False)
+    trailer = pack_trace(3, 41, make_trace_id(3, 41), 123_456_789)
+    sent = encode(
+        Protocol.RolloutBatch,
+        {"obs": np.arange(16, dtype=np.float32)},
+        trace=trailer,
+    )
+    assert len(sent) == 3
+    bad = [sent[0], sent[1], b"g" * 28]  # garbage trailer, valid body
+    try:
+        got = None
+        deadline = time.time() + 60
+        while time.time() < deadline and got is None:
+            pub.send_raw(sent)
+            got = sink.recv_raw(timeout_ms=200)
+        assert got is not None, "relay never forwarded the traced frame"
+        assert got[1] == sent  # all three parts byte-identical
+        assert unpack_trace(got[1][2]) == (3, 41, make_trace_id(3, 41),
+                                           123_456_789)
+
+        pub.send_raw(bad)  # rejected at the relay's peek
+        sent2 = encode(Protocol.RolloutBatch, {"phase": "post"}, trace=trailer)
+        got2 = None
+        deadline = time.time() + 60
+        while time.time() < deadline and got2 is None:
+            pub.send_raw(sent2)
+            got2 = sink.recv_raw(timeout_ms=200)
+            if got2 is not None and got2[1][1] == sent[1]:
+                got2 = None  # stragglers of the first frame
+        assert got2 is not None, "relay died after a garbage-trailer frame"
+        assert got2[1] == sent2
+        assert t.is_alive()
+    finally:
+        stop.set()
+        t.join(timeout=30)
+        sink.close()
+        pub.close()
+    assert not t.is_alive()
+
+
+def test_manager_decode_mode_preserves_trailer():
+    """The A/B baseline (relay_mode="decode") re-encodes at ingest — the
+    trailer must ride through the re-encode so lineage survives either mode."""
+    cfg = small_config(relay_mode="decode")
+    m = Manager(cfg, 0, "127.0.0.1", 0)
+
+    class _NullPub:
+        def send_raw(self, parts):
+            pass
+
+    trailer = pack_trace(2, 7, make_trace_id(2, 7), 99)
+    m._ingest(Protocol.RolloutBatch, {"x": 1}, _NullPub(), trailer)
+    parts = m.queue.popleft()
+    assert len(parts) == 3 and parts[2] == trailer
+    assert decode(parts)[1] == {"x": 1}
 
 
 def test_drop_oldest_granularity_is_one_frame():

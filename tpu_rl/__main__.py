@@ -40,6 +40,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry-port", type=int, default=None,
                    help="serve Prometheus /metrics + /healthz from the "
                    "storage process on this port (0/unset = off)")
+    p.add_argument("--trace-sample-n", type=int, default=None,
+                   help="sample every Nth worker tick into the fleet trace "
+                   "(result_dir/fleet_trace.json); 0/unset = off")
     return p
 
 
@@ -54,6 +57,8 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["mesh_data"] = args.mesh_data
     if args.telemetry_port is not None:
         overrides["telemetry_port"] = args.telemetry_port
+    if args.trace_sample_n is not None:
+        overrides["trace_sample_n"] = args.trace_sample_n
     if overrides:
         cfg = cfg.replace(**overrides)
     machines = (

@@ -299,6 +299,15 @@ class Config:
     # the Chrome trace export at result_dir/trace.json). The recorder only
     # exists when result_dir is set.
     trace_capacity: int = 4096
+    # Rollout-lineage sampling: every Nth worker tick ships a 28-byte trace
+    # context (wid, seq, trace id, send timestamp) as an optional THIRD wire
+    # part; each hop (worker, manager, storage, assembler, learner) records
+    # a span keyed by the trace id, and tpu_rl.obs.merge joins the dumps
+    # into result_dir/fleet_trace.json with linked Perfetto arrows. 0 = off:
+    # no trailer is ever attached and every hop's trace branch reduces to a
+    # single truthiness/length check (same cost model as the telemetry
+    # plane's `is None`).
+    trace_sample_n: int = 0
 
     # ---- runtime-derived (filled by the runner, not the JSON) ----
     obs_shape: tuple[int, ...] = (4,)
@@ -352,6 +361,7 @@ class Config:
         assert self.telemetry_interval_s > 0, self.telemetry_interval_s
         assert self.telemetry_stale_s > 0, self.telemetry_stale_s
         assert self.trace_capacity >= 1, self.trace_capacity
+        assert self.trace_sample_n >= 0, self.trace_sample_n
         assert self.action_repeat >= 1, self.action_repeat
         assert self.std_floor >= 0.0, (
             f"std_floor must be >= 0 (got {self.std_floor}): a negative floor "

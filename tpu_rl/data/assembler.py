@@ -56,12 +56,16 @@ class Trajectory:
     a last-activity timestamp instead of a creation timestamp. When the last
     row fills, ``cols`` is emitted as the window itself — no stacking pass."""
 
-    __slots__ = ("cols", "n", "last_push")
+    __slots__ = ("cols", "n", "last_push", "traces")
 
     def __init__(self, cols: dict[str, np.ndarray], last_push: float = 0.0):
         self.cols = cols
         self.n = 0
         self.last_push = last_push
+        # Rollout-lineage trace ids of sampled ticks that contributed rows
+        # (tpu_rl.obs): None until the first sampled tick touches this
+        # trajectory, so untraced runs never allocate the list.
+        self.traces = None
 
     def __len__(self) -> int:
         return self.n
@@ -118,6 +122,10 @@ class RolloutAssembler:
         self.parked: dict[str, Trajectory] = {}  # done-episodes short of seq_len
         self._oldest_push = float("-inf")  # lower bound on min(last_push)
         self.ready: deque[dict] = deque()
+        # Per-window lineage (trace-id lists), kept aligned with `ready`.
+        # None until the FIRST traced tick arrives (then backfilled with
+        # Nones), so the tracing-off path is byte-identical to before.
+        self.ready_traces: deque | None = None
         # observability counters
         self.n_steps = 0
         self.n_windows = 0
@@ -160,7 +168,7 @@ class RolloutAssembler:
             self._oldest_push = now
         return self._close_row(eid, tj, done)
 
-    def push_tick(self, payload: dict) -> int:
+    def push_tick(self, payload: dict, trace_id: int | None = None) -> int:
         """Feed one whole worker tick (``Protocol.RolloutBatch`` payload:
         each batch field ``(n_envs, width)``, ``id`` a list of episode ids,
         ``done`` ``(n_envs,)``) columnar-wise: one clock read and one stale
@@ -168,12 +176,19 @@ class RolloutAssembler:
         into each episode's preallocated window buffer — no per-step dict
         objects (the ``split_rollout_batch`` + per-step :meth:`push` pair is
         the reference path this replaces on the storage hot loop). Returns
-        the number of windows newly ready."""
+        the number of windows newly ready.
+
+        ``trace_id`` (a sampled tick's rollout-lineage id, tpu_rl.obs) is
+        appended to every trajectory the tick touches, so the windows it
+        lands in can be traced through to the learner. None — the sampling-
+        off state and all unsampled ticks — adds one ``is None`` check."""
         ids = payload["id"]
         done = np.asarray(payload["done"])
         now = self.clock()
         if self.validate:
             self.layout.validate_tick(payload, len(ids))
+        if trace_id is not None:
+            self._track_traces()
         self._drop_stale(now)
         emitted = 0
         for i, eid in enumerate(ids):
@@ -183,6 +198,10 @@ class RolloutAssembler:
                 tj.cols[f][r] = payload[f][i]  # row view -> buffer row
             if seam:
                 tj.cols["is_fir"][r] = 1.0
+            if trace_id is not None:
+                if tj.traces is None:
+                    tj.traces = []
+                tj.traces.append(trace_id)
             tj.n += 1
             tj.last_push = now
             emitted += self._close_row(eid, tj, bool(done[i]))
@@ -190,6 +209,12 @@ class RolloutAssembler:
         if now < self._oldest_push:
             self._oldest_push = now
         return emitted
+
+    def _track_traces(self) -> None:
+        """Activate window-lineage tracking on the first traced tick:
+        backfill alignment for windows already emitted untraced."""
+        if self.ready_traces is None:
+            self.ready_traces = deque(None for _ in self.ready)
 
     def _traj_for(self, eid: str, now: float) -> tuple[Trajectory, bool]:
         """Active trajectory for ``eid``; a new episode splices onto the
@@ -213,7 +238,10 @@ class RolloutAssembler:
     def _close_row(self, eid: str, tj: Trajectory, done: bool) -> int:
         if tj.n >= self.seq_len:
             # The filled buffer IS the window — ownership transfers out.
-            self.ready.append(self.active.pop(eid).cols)
+            out = self.active.pop(eid)
+            self.ready.append(out.cols)
+            if self.ready_traces is not None:
+                self.ready_traces.append(out.traces)
             self.n_windows += 1
             return 1
         if done:
@@ -243,16 +271,42 @@ class RolloutAssembler:
     # ------------------------------------------------------------------- pop
     def pop(self) -> dict | None:
         """Next ready window as a dict of (seq, width) arrays, or None."""
-        return self.ready.popleft() if self.ready else None
+        if not self.ready:
+            return None
+        if self.ready_traces is not None:
+            self.ready_traces.popleft()  # keep lineage aligned; caller
+            # wants only the window — lineage consumers use pop_many_traced
+        return self.ready.popleft()
 
     def pop_many(self, max_windows: int | None = None) -> list[dict]:
         """Drain up to ``max_windows`` ready windows (all, when None) — the
         multi-window companion of :meth:`pop` feeding the stores'
         ``put_many`` burst writes."""
+        windows, _ = self.pop_many_traced(max_windows)
+        return windows
+
+    def pop_many_traced(
+        self, max_windows: int | None = None
+    ) -> tuple[list[dict], list | None]:
+        """:meth:`pop_many` plus each window's lineage (list of trace ids or
+        None per window); the traces list itself is None until lineage
+        tracking has activated — the untraced path allocates nothing extra."""
         n = len(self.ready) if max_windows is None else min(
             max_windows, len(self.ready)
         )
-        return [self.ready.popleft() for _ in range(n)]
+        windows = [self.ready.popleft() for _ in range(n)]
+        if self.ready_traces is None:
+            return windows, None
+        return windows, [self.ready_traces.popleft() for _ in range(n)]
+
+    def requeue(self, windows: list[dict], traces: list | None = None) -> None:
+        """Put rejected windows back at the FRONT in their original order
+        (store-full back-pressure) — replaces direct ``ready`` manipulation
+        so the lineage deque stays aligned."""
+        self.ready.extendleft(reversed(windows))
+        if self.ready_traces is not None:
+            ts = traces if traces is not None else [None] * len(windows)
+            self.ready_traces.extendleft(reversed(ts))
 
     def __len__(self) -> int:
         return len(self.ready)

@@ -11,6 +11,7 @@ from tpu_rl.obs.aggregator import (
     TelemetryAggregator,
     maybe_aggregator,
 )
+from tpu_rl.obs.clocksync import ClockEstimate, ClockSync
 from tpu_rl.obs.exporters import (
     JsonExporter,
     TelemetryHTTPServer,
@@ -18,6 +19,8 @@ from tpu_rl.obs.exporters import (
     render_healthz,
     render_prometheus,
 )
+from tpu_rl.obs.flightrec import FlightRecorder
+from tpu_rl.obs.merge import merge_result_dir, merge_traces
 from tpu_rl.obs.registry import (
     HIST_BUCKETS,
     MetricsRegistry,
@@ -28,7 +31,10 @@ from tpu_rl.obs.registry import (
 from tpu_rl.obs.trace import TraceRecorder
 
 __all__ = [
+    "ClockEstimate",
+    "ClockSync",
     "DEFAULT_STALE_AFTER_S",
+    "FlightRecorder",
     "HIST_BUCKETS",
     "JsonExporter",
     "LEARNER_VERSION_GAUGE",
@@ -41,7 +47,9 @@ __all__ = [
     "TraceRecorder",
     "diff_snapshots",
     "maybe_aggregator",
+    "merge_result_dir",
     "merge_snapshots",
+    "merge_traces",
     "render_healthz",
     "render_prometheus",
 ]

@@ -131,29 +131,50 @@ def render_healthz(
 
 
 class TelemetryHTTPServer:
-    """stdlib HTTP thread serving ``/metrics`` (Prometheus text) and
-    ``/healthz`` (JSON liveness). Daemonized: it must never hold the storage
-    process open at shutdown."""
+    """stdlib HTTP thread serving ``/metrics`` (Prometheus text),
+    ``/healthz`` (JSON liveness) and — when the owner wires a ``tracez``
+    callable — ``/tracez`` (the role's live span ring + clock estimates as
+    JSON). Daemonized: it must never hold the storage process open at
+    shutdown, and :meth:`close` is idempotent and bounded so cluster e2e
+    tests can tear servers down back-to-back without leaking the socket."""
 
-    def __init__(self, agg: TelemetryAggregator, port: int, host: str = ""):
+    def __init__(
+        self,
+        agg: TelemetryAggregator,
+        port: int,
+        host: str = "",
+        tracez=None,
+    ):
         self.agg = agg
+        self.tracez = tracez  # callable -> JSON-able dict, or None
 
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                if self.path.split("?")[0] == "/metrics":
+                path = self.path.split("?")[0]
+                if path == "/metrics":
                     body = render_prometheus(outer.agg).encode()
                     ctype, status = "text/plain; version=0.0.4", 200
-                elif self.path.split("?")[0] == "/healthz":
+                elif path == "/healthz":
                     status, payload = render_healthz(outer.agg)
                     body = (json.dumps(payload, indent=1) + "\n").encode()
                     ctype = "application/json"
+                elif path == "/tracez":
+                    payload = (
+                        outer.tracez() if outer.tracez is not None
+                        else {"trace": None}
+                    )
+                    body = (json.dumps(payload) + "\n").encode()
+                    ctype, status = "application/json", 200
                 else:
                     body, ctype, status = b"not found\n", "text/plain", 404
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                # One request per connection: a keep-alive scraper must not
+                # pin a handler thread across the server's close().
+                self.send_header("Connection", "close")
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -162,7 +183,12 @@ class TelemetryHTTPServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
+        # Don't let server_close() block on a wedged in-flight handler —
+        # handlers are daemon threads, shutdown already stopped the accept
+        # loop, and close() promises to return promptly.
+        self._httpd.block_on_close = False
         self.port = self._httpd.server_address[1]  # resolved when port=0
+        self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="telemetry-http",
@@ -171,6 +197,11 @@ class TelemetryHTTPServer:
         self._thread.start()
 
     def close(self) -> None:
+        """Stop accepting, release the listening socket, reap the serve
+        thread. Safe to call more than once (role finallys may overlap)."""
+        if self._closed:
+            return
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5.0)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import socket
 import threading
 import time
 from collections import deque
@@ -33,14 +34,26 @@ from collections import deque
 class TraceRecorder:
     """Ring buffer of completed spans, exportable as Chrome trace events."""
 
-    def __init__(self, capacity: int = 4096, pid: int = 0):
+    def __init__(
+        self,
+        capacity: int = 4096,
+        pid: int = 0,
+        role: str = "",
+        host: str | None = None,
+    ):
         self.capacity = int(capacity)
         self.pid = int(pid)
+        self.role = role
+        self.host = socket.gethostname() if host is None else host
         self._events: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self.n_recorded = 0
-        # One shared epoch so timestamps from every thread share an axis.
+        # One shared epoch so timestamps from every thread share an axis —
+        # paired with a wall-clock anchor taken at the same instant so dumps
+        # from different processes can be merged onto ONE fleet axis
+        # (tpu_rl.obs.merge): a span's wall time is wall_anchor_ns + rel.
         self._t0 = time.perf_counter()
+        self.wall_anchor_ns = time.time_ns()
 
     # ---------------------------------------------------------------- record
     def add(
@@ -68,9 +81,13 @@ class TraceRecorder:
         return len(self._events)
 
     # ---------------------------------------------------------------- export
-    def to_chrome(self) -> dict:
+    def to_chrome(self, extra_meta: dict | None = None) -> dict:
         """Chrome trace-event JSON object format: complete ("X") events with
-        microsecond timestamps, one named lane per recording thread."""
+        microsecond timestamps, one named lane per recording thread. The
+        top-level ``meta`` block (role/pid/host + the wall-clock anchor of
+        the perf_counter epoch) is what makes dumps from different processes
+        mergeable in principle — without it a ring's timestamps are an
+        offset-unknown local axis."""
         with self._lock:
             events = list(self._events)
         trace_events: list[dict] = []
@@ -88,6 +105,16 @@ class TraceRecorder:
             if args:
                 ev["args"] = args
             trace_events.append(ev)
+        if self.role:
+            trace_events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": self.pid,
+                    "tid": 0,
+                    "args": {"name": f"{self.role} {self.host}/{self.pid}"},
+                }
+            )
         # Thread-name metadata so the viewer shows "main"/"feeder" lanes.
         for tname, tid_i in tids.items():
             trace_events.append(
@@ -99,14 +126,26 @@ class TraceRecorder:
                     "args": {"name": tname},
                 }
             )
-        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        meta = {
+            "role": self.role,
+            "pid": self.pid,
+            "host": self.host,
+            "wall_anchor_ns": self.wall_anchor_ns,
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        return {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "meta": meta,
+        }
 
-    def dump(self, path: str) -> None:
+    def dump(self, path: str, extra_meta: dict | None = None) -> None:
         """Atomic write (tmp + rename) so a viewer never loads a torn file."""
         import os
 
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump(self.to_chrome(), f)
+            json.dump(self.to_chrome(extra_meta), f)
         os.replace(tmp, path)

@@ -127,7 +127,16 @@ class AsyncPublisher:
                 # weights: workers echo it through their rollouts so storage
                 # can measure per-worker policy staleness (tpu_rl.obs).
                 self._pub.send(
-                    Protocol.Model, {"actor": jax.device_get(snap), "ver": ver}
+                    Protocol.Model,
+                    {
+                        "actor": jax.device_get(snap),
+                        "ver": ver,
+                        # Clock-sync echo origin (t0): workers pair this with
+                        # their receive time and ship both back on their
+                        # Telemetry snapshots, closing the NTP round trip at
+                        # the storage edge (tpu_rl.obs.clocksync).
+                        "t_tx": time.time_ns(),
+                    },
                 )
             except BaseException as e:  # noqa: BLE001 — surfaces in publish()
                 self._error = e
@@ -321,10 +330,13 @@ class LearnerService:
         # The deep-dive companion is the jax.profiler window below
         # (profile_dir/profile_start/profile_steps).
         if cfg.result_dir is not None:
-            from tpu_rl.obs import TraceRecorder
+            from tpu_rl.obs import TraceRecorder, flightrec
 
             self._tracer = TraceRecorder(
-                capacity=cfg.trace_capacity, pid=os.getpid()
+                capacity=cfg.trace_capacity, pid=os.getpid(), role="learner"
+            )
+            flightrec.install(
+                "learner", cfg.result_dir, tracer=self._tracer, cfg=cfg
             )
         tracer = self._tracer
         # One timed window per DISPATCH; a chained dispatch carries
@@ -668,7 +680,12 @@ class LearnerService:
             import jax
 
             pub.send(
-                Protocol.Model, {"actor": jax.device_get(actor), "ver": ver}
+                Protocol.Model,
+                {
+                    "actor": jax.device_get(actor),
+                    "ver": ver,
+                    "t_tx": time.time_ns(),
+                },
             )
         if self._tracer is not None:
             # Async path: this span is the cheap dispatch cost the hot loop

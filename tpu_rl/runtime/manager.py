@@ -26,11 +26,12 @@ coordination.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 
 from tpu_rl.config import Config
-from tpu_rl.runtime.protocol import Protocol, decode, encode
+from tpu_rl.runtime.protocol import Protocol, decode, encode, unpack_trace
 from tpu_rl.runtime.transport import Pub, Sub
 
 RELAY_QUEUE_MAX = 1024  # reference manager.py:45-47
@@ -77,11 +78,17 @@ class Manager:
         self.model_loads: dict = {}
         self.worker_rejected: dict = {}
         self._sub: Sub | None = None
+        # Rollout-lineage tracing (tpu_rl.obs): spans recorded ONLY for
+        # frames that arrive with a trace trailer (the third wire part), so
+        # the untraced relay path's trace cost is one length check. None
+        # when there is nowhere to dump (no result_dir).
+        self._tracer = None
+        self._trace_path = None
 
     def run(self) -> None:
         sub = self._sub = Sub("*", self.worker_port, bind=True)
         pub = Pub(*self.learner_addr, bind=False)
-        recv = sub.recv_raw if self.raw else sub.recv
+        recv = sub.recv_raw if self.raw else sub.recv_traced
 
         # Telemetry (tpu_rl.obs): the relay's own health snapshot, emitted
         # on the clock onto the storage-bound PUB. None when the plane has
@@ -91,10 +98,38 @@ class Manager:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
 
             registry = MetricsRegistry(role="manager")
+
+            def _send_snap(snap):
+                # One-way clock-sync stamp: the storage edge pairs our send
+                # time with its receive time (no return path to a relay, so
+                # this bounds rather than measures the offset).
+                snap["clk"] = {"t2": time.time_ns()}
+                pub.send(Protocol.Telemetry, snap)
+
             emitter = PeriodicSnapshot(
-                registry,
-                lambda snap: pub.send(Protocol.Telemetry, snap),
-                interval_s=self.cfg.telemetry_interval_s,
+                registry, _send_snap, interval_s=self.cfg.telemetry_interval_s
+            )
+        if self.cfg.result_dir is not None:
+            from tpu_rl.obs import TraceRecorder, flightrec
+
+            self._tracer = TraceRecorder(
+                capacity=self.cfg.trace_capacity,
+                pid=os.getpid(),
+                role="manager",
+            )
+            self._trace_path = os.path.join(
+                self.cfg.result_dir, f"trace-manager-{os.getpid()}.json"
+            )
+            flightrec.install(
+                "manager",
+                self.cfg.result_dir,
+                tracer=self._tracer,
+                cfg=self.cfg,
+                extra=lambda: {
+                    "queue_depth": len(self.queue),
+                    "n_forwarded": self.n_forwarded,
+                    "n_dropped": self.n_dropped,
+                },
             )
         try:
             while not self._stopped():
@@ -113,36 +148,75 @@ class Manager:
                         self.n_stats
                     )
                     registry.gauge("manager-queue-depth").set(len(self.queue))
-                    emitter.maybe_emit()
+                    if emitter.maybe_emit() and self._tracer is not None:
+                        # Trace dumps ride the telemetry cadence so a recent
+                        # ring is always on disk for the merger.
+                        self._tracer.dump(self._trace_path)
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
                 if not moved:
                     # Idle: block briefly on the socket instead of spinning.
                     msg = recv(timeout_ms=50)
                     if msg is not None:
-                        self._ingest(*msg, pub)
+                        self._ingest(
+                            msg[0],
+                            msg[1],
+                            pub,
+                            msg[2] if len(msg) > 2 else None,
+                        )
         finally:
+            if self._tracer is not None and self._tracer.n_recorded:
+                self._tracer.dump(self._trace_path)
             sub.close()
             pub.close()
 
     # ---------------------------------------------------------------- pump
     def _pump(self, sub: Sub, pub: Pub) -> int:
         moved = 0
-        drain = sub.drain_raw if self.raw else sub.drain
-        for proto, item in drain():
-            self._ingest(proto, item, pub)
+        drain = sub.drain_raw if self.raw else sub.drain_traced
+        for got in drain():
+            self._ingest(
+                got[0], got[1], pub, got[2] if len(got) > 2 else None
+            )
             moved += 1
         while self.queue:
             parts = self.queue.popleft()
             pub.send_raw(parts)
             self.n_forwarded += 1
-            self.n_forward_bytes += len(parts[0]) + len(parts[1])
+            if len(parts) == 3:
+                # Sampled frame: the trailer's bytes count too, and the
+                # forward hop lands in the lineage timeline.
+                self.n_forward_bytes += (
+                    len(parts[0]) + len(parts[1]) + len(parts[2])
+                )
+                if self._tracer is not None:
+                    self._note_trace("relay-out", parts[2])
+            else:
+                self.n_forward_bytes += len(parts[0]) + len(parts[1])
             moved += 1
         return moved
 
-    def _ingest(self, proto: Protocol, item, pub: Pub) -> None:
+    def _note_trace(self, name: str, trailer: bytes) -> None:
+        """One lineage span for a trailer-carrying frame at this hop."""
+        t0 = time.perf_counter()
+        try:
+            wid, seq, trace_id, _ts = unpack_trace(trailer)
+        except ValueError:
+            return  # peek validated shape/magic; don't crash on a race
+        self._tracer.add(
+            name,
+            t0,
+            time.perf_counter() - t0,
+            args={"trace_id": trace_id, "wid": wid, "seq": seq},
+        )
+
+    def _ingest(
+        self, proto: Protocol, item, pub: Pub, trailer: bytes | None = None
+    ) -> None:
         """One received message. ``item`` is the opaque wire-parts list in
-        raw mode, the decoded payload in decode mode."""
+        raw mode, the decoded payload in decode mode (where ``trailer`` is
+        the frame's trace context, re-attached on the re-encode so the A/B
+        baseline preserves lineage)."""
         if proto in (Protocol.Rollout, Protocol.RolloutBatch, Protocol.Telemetry):
             # Relay a RolloutBatch as one frame — never unpacked into
             # per-step messages. Drop-oldest granularity is one frame: a
@@ -150,7 +224,9 @@ class Manager:
             # most stale together. Telemetry snapshots take the same path:
             # tiny frames, forwarded verbatim in raw mode (the aggregator at
             # the storage edge is their consumer, not this relay).
-            parts = item if self.raw else encode(proto, item)
+            parts = item if self.raw else encode(proto, item, trace=trailer)
+            if self._tracer is not None and len(parts) == 3:
+                self._note_trace("relay-in", parts[2])
             if len(self.queue) == self.queue.maxlen:
                 # deque(maxlen) evicts silently; count the shed frame so the
                 # loss is visible fleet-wide (satellite: silent drop fix).

@@ -297,10 +297,68 @@ _MAX_RAW = 1 << 30
 # native tpurl_crc32, which implements the same polynomial).
 _crc = zlib.crc32
 
+# ------------------------------------------------------------- trace trailer
+# Rollout-lineage trace context (tpu_rl.obs): a sampled frame carries its
+# origin as an OPTIONAL THIRD wire part, so the raw relay forwards it
+# verbatim (send_multipart ships whatever parts arrived) and every other
+# frame stays the exact 2-part message it always was. Fixed-size struct, own
+# magic — a relay can validate it in O(1) without touching the payload.
+_TRAILER_MAGIC = 0x5443  # "TC"
+_TRAILER_VERSION = 1
+# magic u16, version u8, pad, wid i32, frame seq u32, trace id u64,
+# sender's time.time_ns() at send i64
+_TRAILER = struct.Struct("<HBxiIQq")
+# The only kinds that may carry a trailer: the rollout data plane. A trailer
+# on anything else (Model, Stat, control frames) is a hostile/corrupt frame
+# and is rejected into the receiver's ``n_rejected`` path.
+TRACE_KINDS = frozenset({Protocol.Rollout, Protocol.RolloutBatch})
 
-def encode(proto: Protocol, payload: Any) -> list[bytes]:
-    """-> 2-part multipart message ``[proto_byte, frame]`` (reference
-    ``encode``, ``utils/utils.py:244-245``)."""
+
+def make_trace_id(wid: int, seq: int) -> int:
+    """Deterministic fleet-unique trace id for a sampled tick: the origin
+    worker in the high bits, its tick sequence below. Stays under 2**54 so
+    the id survives JSON consumers that parse ints as doubles."""
+    return ((wid & 0x3FFFFF) << 32) | (seq & 0xFFFFFFFF)
+
+
+def pack_trace(wid: int, seq: int, trace_id: int, send_ts_ns: int) -> bytes:
+    """Encode one trace-context trailer (the optional third wire part)."""
+    return _TRAILER.pack(
+        _TRAILER_MAGIC, _TRAILER_VERSION, wid, seq & 0xFFFFFFFF,
+        trace_id & 0xFFFFFFFFFFFFFFFF, send_ts_ns,
+    )
+
+
+def unpack_trace(trailer: bytes) -> tuple[int, int, int, int]:
+    """-> ``(wid, seq, trace_id, send_ts_ns)``; ValueError on garbage."""
+    if len(trailer) != _TRAILER.size:
+        raise ValueError(f"bad trace trailer size {len(trailer)}")
+    magic, version, wid, seq, trace_id, ts = _TRAILER.unpack(trailer)
+    if magic != _TRAILER_MAGIC or version != _TRAILER_VERSION:
+        raise ValueError(f"bad trace trailer magic/version {magic:#x}/{version}")
+    return wid, seq, trace_id, ts
+
+
+def _check_trailer(proto: Protocol, parts: list[bytes]) -> None:
+    """Relay-grade trailer validation (size cap = the exact struct size, kind
+    allowlist, magic/version) — no payload decode, same cost class as
+    :func:`peek`'s header checks."""
+    if proto not in TRACE_KINDS:
+        raise ValueError(f"trace trailer not allowed on {proto!r}")
+    trailer = parts[2]
+    if len(trailer) != _TRAILER.size:
+        raise ValueError(f"bad trace trailer size {len(trailer)}")
+    magic, version = _TRAILER.unpack_from(trailer)[:2]
+    if magic != _TRAILER_MAGIC or version != _TRAILER_VERSION:
+        raise ValueError(f"bad trace trailer magic/version {magic:#x}/{version}")
+
+
+def encode(
+    proto: Protocol, payload: Any, trace: bytes | None = None
+) -> list[bytes]:
+    """-> multipart message ``[proto_byte, frame]`` (reference ``encode``,
+    ``utils/utils.py:244-245``), plus the optional trace-context trailer as a
+    third part (see :func:`pack_trace`)."""
     raw = pack(payload)
     if len(raw) < _MIN_COMPRESS:
         codec, body = Codec.RAW, raw
@@ -311,7 +369,9 @@ def encode(proto: Protocol, payload: Any) -> list[bytes]:
     if codec != Codec.RAW and len(body) >= len(raw):
         codec, body = Codec.RAW, raw  # incompressible: ship raw
     header = _HEADER.pack(_MAGIC, _VERSION, codec, len(raw), _crc(body) & 0xFFFFFFFF)
-    return [bytes([proto]), header + body]
+    if trace is None:
+        return [bytes([proto]), header + body]
+    return [bytes([proto]), header + body, trace]
 
 
 def peek(parts: list[bytes]) -> Protocol:
@@ -323,8 +383,11 @@ def peek(parts: list[bytes]) -> Protocol:
     only hop that consumes rollout payloads. Raises ValueError on frames a
     relay must not forward (foreign publishers, truncated frames, hostile
     size declarations); a corrupt *body* under a valid header passes peek
-    and is rejected downstream by decode's CRC."""
-    if len(parts) != 2 or len(parts[0]) != 1:
+    and is rejected downstream by decode's CRC. A third part, when present,
+    must be a valid trace trailer on a kind that allows one
+    (:func:`_check_trailer`) — anything else is rejected here so relays never
+    amplify garbage trailers."""
+    if len(parts) not in (2, 3) or len(parts[0]) != 1:
         raise ValueError(f"malformed multipart message: {len(parts)} parts")
     proto = Protocol(parts[0][0])  # ValueError on an unknown proto byte
     frame = parts[1]
@@ -341,15 +404,22 @@ def peek(parts: list[bytes]) -> Protocol:
             raise ValueError("raw body size mismatch")
     elif codec not in (Codec.LZ4, Codec.ZLIB):
         raise ValueError(f"unknown codec {codec}")
+    if len(parts) == 3:
+        _check_trailer(proto, parts)
     return proto
 
 
 def decode(parts: list[bytes]) -> tuple[Protocol, Any]:
     """Inverse of :func:`encode` (reference ``decode``,
-    ``utils/utils.py:248-249``). Raises ValueError on malformed frames."""
-    if len(parts) != 2 or len(parts[0]) != 1:
+    ``utils/utils.py:248-249``). Raises ValueError on malformed frames —
+    including a trace trailer on a kind that doesn't allow one (the trailer
+    itself is otherwise ignored here; lineage consumers read it via
+    ``Sub.recv_traced``)."""
+    if len(parts) not in (2, 3) or len(parts[0]) != 1:
         raise ValueError(f"malformed multipart message: {len(parts)} parts")
     proto = Protocol(parts[0][0])
+    if len(parts) == 3:
+        _check_trailer(proto, parts)
     frame = parts[1]
     if len(frame) < _HEADER.size:
         raise ValueError("short frame")

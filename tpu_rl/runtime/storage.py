@@ -37,7 +37,7 @@ from tpu_rl.runtime.mailbox import (
     SLOT_RELAY_DROPPED,
     STAT_SLOTS,
 )
-from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.protocol import Protocol, unpack_trace
 from tpu_rl.runtime.transport import Sub
 
 # Slot layout lives in tpu_rl.runtime.mailbox (shared with the learner's
@@ -73,6 +73,15 @@ class LearnerStorage:
         self._http = None
         self._json_exp = None
         self._tb_exp = None
+        # Rollout-lineage tracing (tpu_rl.obs): the storage edge records the
+        # ingest + window-close hops for sampled frames, estimates every
+        # remote source's clock offset from telemetry echoes, and auto-
+        # merges all roles' dumps into result_dir/fleet_trace.json at
+        # shutdown. Everything None when there is no result_dir; untraced
+        # frames cost one `is None` check.
+        self._tracer = None
+        self._trace_path = None
+        self.clocksync = None
 
     def run(self) -> None:
         cfg = self.cfg
@@ -80,14 +89,15 @@ class LearnerStorage:
         assembler = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
         store = make_store(cfg, layout, handles=self.handles)
         sub = self._sub = Sub("*", self.learner_port, bind=True)
+        self._setup_trace(assembler)
         self._setup_telemetry()
         try:
             while not self._stopped():
-                msg = sub.recv(timeout_ms=50)
+                msg = sub.recv_traced(timeout_ms=50)
                 if msg is not None:
-                    self._ingest(*msg, assembler)
-                for proto, payload in sub.drain():
-                    self._ingest(proto, payload, assembler)
+                    self._ingest(msg[0], msg[1], assembler, msg[2])
+                for proto, payload, trailer in sub.drain_traced():
+                    self._ingest(proto, payload, assembler, trailer)
                 self._flush(assembler, store)
                 if self.aggregator is not None:
                     self._telemetry_tick()
@@ -95,7 +105,69 @@ class LearnerStorage:
                     self.heartbeat.value = time.time()
         finally:
             sub.close()
+            self._close_trace()
             self._close_telemetry()
+
+    # ----------------------------------------------------------------- trace
+    def _setup_trace(self, assembler) -> None:
+        cfg = self.cfg
+        if cfg.result_dir is None:
+            return
+        from tpu_rl.obs import ClockSync, TraceRecorder, flightrec
+
+        self._tracer = TraceRecorder(
+            capacity=cfg.trace_capacity, pid=os.getpid(), role="storage"
+        )
+        self._trace_path = os.path.join(
+            cfg.result_dir, f"trace-storage-{os.getpid()}.json"
+        )
+        # Offsets of every remote process against THIS host's clock (learner
+        # and storage are shm-colocated, so this is the fleet's reference).
+        self.clocksync = ClockSync()
+        flightrec.install(
+            "storage",
+            cfg.result_dir,
+            tracer=self._tracer,
+            cfg=cfg,
+            extra=lambda: {
+                "assembler": assembler.stats,
+                "windows": self.n_windows,
+                "requeue_full": self.n_requeue_full,
+            },
+        )
+
+    def _tracez(self) -> dict:
+        """Live snapshot for the HTTP /tracez endpoint."""
+        return {
+            "role": "storage",
+            "pid": os.getpid(),
+            "trace": (
+                self._tracer.to_chrome() if self._tracer is not None else None
+            ),
+            "clock": (
+                self.clocksync.snapshot() if self.clocksync is not None else {}
+            ),
+        }
+
+    def _close_trace(self) -> None:
+        if self._tracer is None:
+            return
+        extra = (
+            {"clock": self.clocksync.snapshot()}
+            if self.clocksync is not None
+            else None
+        )
+        self._tracer.dump(self._trace_path, extra_meta=extra)
+        # Auto-merge at shutdown: storage is the last data-plane process to
+        # exit and every role dumps on the telemetry cadence, so what's on
+        # disk now is the fleet's final (or near-final) state. Best-effort —
+        # the per-role dumps stay either way and the CLI merger can rerun.
+        try:
+            from tpu_rl.obs.merge import merge_result_dir
+
+            merge_result_dir(self.cfg.result_dir)
+        except Exception as e:  # noqa: BLE001 — shutdown must not crash
+            print(f"[storage] fleet-trace merge failed: {e!r}", flush=True)
 
     # ------------------------------------------------------------- telemetry
     def _setup_telemetry(self) -> None:
@@ -119,7 +191,9 @@ class LearnerStorage:
             stale_after_s=cfg.telemetry_stale_s,
         )
         if cfg.telemetry_port > 0:
-            self._http = TelemetryHTTPServer(self.aggregator, cfg.telemetry_port)
+            self._http = TelemetryHTTPServer(
+                self.aggregator, cfg.telemetry_port, tracez=self._tracez
+            )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
                 self.aggregator,
@@ -147,6 +221,13 @@ class LearnerStorage:
         if self._json_exp is not None and self._json_exp.maybe_export():
             if self._tb_exp is not None:
                 self._tb_exp.export(self.aggregator)
+            if self._tracer is not None:
+                # Ride the JSON exporter's cadence: a recent storage ring
+                # (with the clock map the merger needs) is always on disk.
+                self._tracer.dump(
+                    self._trace_path,
+                    extra_meta={"clock": self.clocksync.snapshot()},
+                )
 
     def _close_telemetry(self) -> None:
         if self._http is not None:
@@ -157,7 +238,9 @@ class LearnerStorage:
             self._tb_exp.export(self.aggregator)
             self._tb_exp.close()
 
-    def _ingest(self, proto: Protocol, payload, assembler) -> None:
+    def _ingest(
+        self, proto: Protocol, payload, assembler, trailer: bytes | None = None
+    ) -> None:
         if proto == Protocol.Rollout:
             assembler.push(payload)
         elif proto == Protocol.RolloutBatch:
@@ -170,6 +253,9 @@ class LearnerStorage:
                     self.aggregator.observe_staleness(
                         int(payload.get("wid", -1)), ver
                     )
+            trace_id = None
+            if trailer is not None and self._tracer is not None:
+                trace_id = self._note_ingest(trailer)
             # One worker tick, all envs stacked: unpack at the storage edge
             # (the only hop that needs per-step granularity — the assembler
             # keys on episode id).
@@ -179,15 +265,64 @@ class LearnerStorage:
                     assembler.push(step)
             else:
                 # Columnar: the whole tick in one call, row views per env.
-                assembler.push_tick(payload)
+                assembler.push_tick(payload, trace_id=trace_id)
         elif proto == Protocol.Stat:
             self._relay_stat(payload)
         elif proto == Protocol.Telemetry:
             if self.aggregator is not None:
+                if self.clocksync is not None and isinstance(payload, dict):
+                    self._clock_sample(payload)
                 self.aggregator.ingest(payload)
 
+    def _note_ingest(self, trailer: bytes) -> int | None:
+        """Record the storage-ingest hop for a sampled frame; returns its
+        trace id for the assembler's window lineage."""
+        t0 = time.perf_counter()
+        try:
+            wid, seq, trace_id, t_send_ns = unpack_trace(trailer)
+        except ValueError:
+            return None  # decode validated shape/magic; never crash on it
+        self._tracer.add(
+            "storage-ingest",
+            t0,
+            time.perf_counter() - t0,
+            args={
+                "trace_id": trace_id,
+                "wid": wid,
+                "seq": seq,
+                # Raw (uncorrected) transport latency worker->here; the
+                # merged timeline shows the clock-corrected truth.
+                "wire_ns": time.time_ns() - t_send_ns,
+            },
+        )
+        return trace_id
+
+    def _clock_sample(self, payload: dict) -> None:
+        """Fold one Telemetry snapshot's ``clk`` stamps into the clock-sync
+        estimator: a full round trip when the source echoes a Model
+        broadcast (workers), one-way otherwise (managers)."""
+        clk = payload.get("clk")
+        if not isinstance(clk, dict):
+            return
+        t2 = clk.get("t2")
+        if not isinstance(t2, int):
+            return
+        t3 = time.time_ns()
+        key = (
+            f"{payload.get('role', '?')}/{payload.get('host', '?')}"
+            f"/{payload.get('pid', '?')}"
+        )
+        t0, t1 = clk.get("t0"), clk.get("t1")
+        if isinstance(t0, int) and isinstance(t1, int):
+            self.clocksync.add_round_trip(key, t0, t1, t2, t3)
+        else:
+            self.clocksync.add_one_way(key, t2, t3)
+
     def _flush(self, assembler: RolloutAssembler, store) -> None:
-        windows = assembler.pop_many()
+        if self._tracer is not None:
+            windows, traces = assembler.pop_many_traced()
+        else:
+            windows, traces = assembler.pop_many(), None
         if not windows:
             return
         accepted = store.put_many(windows)
@@ -196,8 +331,27 @@ class LearnerStorage:
             # On-policy store full: the learner hasn't consumed yet. Requeue
             # the rejected tail in order and yield (reference spins on
             # ``num < mem_size``, ``learner_storage.py:139``).
-            assembler.ready.extendleft(reversed(windows[accepted:]))
+            assembler.requeue(
+                windows[accepted:],
+                traces[accepted:] if traces is not None else None,
+            )
             self.n_requeue_full += 1
+        if traces is not None:
+            t0 = time.perf_counter()
+            for tr in traces[:accepted]:
+                if not tr:
+                    continue
+                # A window that contains rows from sampled ticks closes
+                # here: the last lineage hop the wire can measure (the shm
+                # plane carries no metadata; the merger synthesizes the
+                # learner consume from the first train-step after this).
+                for tid in tr:
+                    self._tracer.add(
+                        "window-close",
+                        t0,
+                        time.perf_counter() - t0,
+                        args={"trace_id": tid},
+                    )
 
     def _relay_stat(self, payload) -> None:
         """Manager sends ``{"mean": m, "n": window}``; fold into the stat

@@ -54,12 +54,18 @@ class Pub:
         ep = _endpoint(ip, port)
         self.sock.bind(ep) if bind else self.sock.connect(ep)
 
-    def send(self, proto: Protocol, payload: Any) -> None:
-        self.sock.send_multipart(encode(proto, payload))
+    def send(
+        self, proto: Protocol, payload: Any, trace: bytes | None = None
+    ) -> None:
+        """``trace`` (a ``protocol.pack_trace`` trailer) rides as the
+        optional third wire part on sampled rollout frames; None (the
+        default and the sampling-off state) keeps the exact 2-part frame."""
+        self.sock.send_multipart(encode(proto, payload, trace))
 
     def send_raw(self, parts: list[bytes]) -> None:
         """Forward already-encoded wire parts verbatim — the zero-copy relay
-        hop (no pack/compress/CRC; zmq ships the same buffers it received)."""
+        hop (no pack/compress/CRC; zmq ships the same buffers it received).
+        A trace trailer, being just a third part, is forwarded for free."""
         self.sock.send_multipart(parts)
 
     def close(self) -> None:
@@ -105,6 +111,41 @@ class Sub:
                 yield decode(parts)
             except ValueError:
                 self.n_rejected += 1
+
+    def recv_traced(
+        self, timeout_ms: int | None = None
+    ) -> tuple[Protocol, Any, bytes | None] | None:
+        """:meth:`recv` plus the raw trace trailer when the frame carried one
+        (already validated by ``decode``; parse with ``protocol.unpack_trace``
+        at the consumer). The 2-part common case yields ``trailer=None`` with
+        no extra work beyond one length check."""
+        if timeout_ms is not None:
+            if not self.sock.poll(timeout_ms):
+                return None
+        parts = self.sock.recv_multipart()
+        try:
+            proto, payload = decode(parts)
+        except ValueError:
+            self.n_rejected += 1
+            return None
+        return proto, payload, parts[2] if len(parts) == 3 else None
+
+    def drain_traced(
+        self, max_msgs: int = 1024
+    ) -> Iterator[tuple[Protocol, Any, bytes | None]]:
+        """Yield every decodable queued message with its trace trailer (or
+        None) — the lineage-aware counterpart of :meth:`drain`."""
+        for _ in range(max_msgs):
+            try:
+                parts = self.sock.recv_multipart(zmq.NOBLOCK)
+            except zmq.Again:
+                return
+            try:
+                proto, payload = decode(parts)
+            except ValueError:
+                self.n_rejected += 1
+                continue
+            yield proto, payload, parts[2] if len(parts) == 3 else None
 
     def recv_raw(
         self, timeout_ms: int | None = None

@@ -35,6 +35,7 @@ forces ``JAX_PLATFORMS=cpu`` into worker/manager/storage children.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 import uuid
@@ -43,7 +44,7 @@ import numpy as np
 
 from tpu_rl.config import Config
 from tpu_rl.runtime.env import EnvAdapter
-from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.protocol import Protocol, make_trace_id, pack_trace
 from tpu_rl.runtime.transport import MODEL_HWM, Pub, Sub
 
 
@@ -90,6 +91,12 @@ class Worker:
         # worker keeps announcing itself to /healthz. Disabled (None) when
         # the plane has no sink, so the tick loop pays one `is None` check.
         registry = emitter = None
+        # Clock-sync echo (tpu_rl.obs.clocksync): (t0, t1) of the newest
+        # Model broadcast — t0 the learner's send stamp, t1 our receive
+        # stamp — shipped inside Telemetry snapshots so the storage edge can
+        # close a full NTP round trip through this worker. None until the
+        # first stamped broadcast arrives.
+        clk_echo: list | None = None
         if cfg.telemetry_enabled:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
 
@@ -99,10 +106,35 @@ class Worker:
 
             def _send_snap(snap, _wid=self.worker_id):
                 snap["wid"] = _wid  # aggregator source key + UI grouping
+                clk = {"t2": time.time_ns()}  # our clock at snapshot send
+                if clk_echo is not None:
+                    clk["t0"], clk["t1"] = clk_echo
+                snap["clk"] = clk
                 pub.send(Protocol.Telemetry, snap)
 
             emitter = PeriodicSnapshot(
                 registry, _send_snap, interval_s=cfg.telemetry_interval_s
+            )
+
+        # Rollout-lineage tracing (tpu_rl.obs): every trace_sample_n-th tick
+        # ships a trace-context trailer as the frame's third wire part and
+        # records a local span. sample_n == 0 (the default) keeps the loop's
+        # entire trace branch to one falsy check; the recorder itself needs
+        # result_dir to have somewhere to dump.
+        sample_n = int(cfg.trace_sample_n)
+        tracer = None
+        trace_path = None
+        if cfg.result_dir is not None:
+            from tpu_rl.obs import TraceRecorder, flightrec
+
+            tracer = TraceRecorder(
+                capacity=cfg.trace_capacity, pid=os.getpid(), role="worker"
+            )
+            trace_path = os.path.join(
+                cfg.result_dir, f"trace-worker-{os.getpid()}.json"
+            )
+            flightrec.install(
+                "worker", cfg.result_dir, tracer=tracer, cfg=cfg
             )
 
         family = build_family(cfg)
@@ -163,9 +195,20 @@ class Worker:
         # RolloutBatch so storage can measure policy staleness per worker;
         # -1 = still on local random init (never broadcast-loaded).
         policy_ver = -1
+        tick_seq = 0  # advances only while lineage sampling is on
 
         try:
             while not self._stopped():
+                # Lineage sampling decision for this tick (off: one falsy
+                # check). The sampled tick's span covers act + env-step +
+                # publish — the worker-side cost of the frame.
+                sampled = False
+                if sample_n:
+                    tick_seq += 1
+                    sampled = tick_seq % sample_n == 0
+                    if sampled:
+                        t_tick = time.perf_counter()
+                        trace_id = make_trace_id(self.worker_id, tick_seq)
                 # Hot-reload the freshest broadcast params (reference
                 # ``req_model`` task, ``worker.py:62-72``).
                 for proto, payload in model_sub.drain(max_msgs=MODEL_HWM):
@@ -173,6 +216,12 @@ class Worker:
                         params = {"actor": payload["actor"]}
                         policy_ver = int(payload.get("ver", -1))
                         n_model_loads += 1
+                        if registry is not None:
+                            # Clock-sync echo: pair the learner's send stamp
+                            # with our receive stamp (t0, t1).
+                            t_tx = payload.get("t_tx")
+                            if isinstance(t_tx, int):
+                                clk_echo = [t_tx, time.time_ns()]
 
                 reply = remote.act(obs, is_fir) if remote is not None else None
                 if remote is not None and reply is None:
@@ -273,6 +322,13 @@ class Worker:
                     if reply is not None
                     else policy_ver
                 )
+                trailer = (
+                    pack_trace(
+                        self.worker_id, tick_seq, trace_id, time.time_ns()
+                    )
+                    if sampled
+                    else None
+                )
                 pub.send(
                     Protocol.RolloutBatch,
                     dict(
@@ -289,7 +345,15 @@ class Worker:
                         wid=self.worker_id,
                         ver=tick_ver,
                     ),
+                    trace=trailer,
                 )
+                if sampled and tracer is not None:
+                    tracer.add(
+                        "worker-tick",
+                        t_tick,
+                        time.perf_counter() - t_tick,
+                        args={"trace_id": trace_id, "seq": tick_seq},
+                    )
 
                 # Carry forward; zero only the rows whose episode ended
                 # (where(), not multiply: a transient NaN in a dying
@@ -319,7 +383,11 @@ class Worker:
                         model_sub.n_rejected
                         + (remote.n_rejected if remote else remote_rejected)
                     )
-                    emitter.maybe_emit()
+                    if emitter.maybe_emit() and tracer is not None:
+                        # Trace dumps ride the telemetry cadence: no clock
+                        # of their own, and a crash between dumps still
+                        # leaves a recent ring on disk for the merger.
+                        tracer.dump(trace_path)
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
                 if cfg.worker_step_sleep > 0:
@@ -328,6 +396,8 @@ class Worker:
                     # N env-steps per throttle window.
                     time.sleep(cfg.worker_step_sleep)
         finally:
+            if tracer is not None and tracer.n_recorded:
+                tracer.dump(trace_path)
             for env in envs:
                 env.close()
             pub.close()

@@ -65,4 +65,13 @@ def role_entry(
                 save_error_log(role, exc, log_root)
             except OSError:
                 pass  # never mask the real failure with a logging error
+            try:
+                # Flight recorder (tpu_rl.obs.flightrec): the role installed
+                # one at startup when result_dir is set — dump its span ring
+                # + config fingerprint next to the text log for post-mortems.
+                from tpu_rl.obs import flightrec
+
+                flightrec.dump_on_crash(exc)
+            except Exception:
+                pass  # never mask the real failure with a recorder error
         raise
