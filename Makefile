@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: lint format-check test relay-smoke obs-smoke trace-smoke ci
+.PHONY: lint format-check test relay-smoke obs-smoke trace-smoke chaos-smoke ci
 
 lint:
 	ruff check .
@@ -34,4 +34,11 @@ obs-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/trace_smoke.py
 
-ci: lint test relay-smoke obs-smoke trace-smoke
+# Chaos smoke: run the cluster under a deterministic fault plan (worker
+# kill + rollout corruption + relay delay) and assert the run completes,
+# >=1 supervised restart happened, and injected corruptions == fleet
+# rejected frames (exact fault accounting).
+chaos-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/chaos_smoke.py
+
+ci: lint test relay-smoke obs-smoke trace-smoke chaos-smoke

@@ -1,0 +1,181 @@
+"""Chaos smoke: boot the smallest real cluster under a deterministic fault
+plan — a worker SIGKILL, probabilistic rollout corruption, and relay send
+delays — and assert the run STILL completes and every injected fault is
+accounted for:
+
+- the learner reaches ``max_updates`` and exits cleanly,
+- the supervisor restarted at least one child (the chaos kill),
+- every injected corruption shows up in the fleet's rejected-frame
+  counters (injected == rejected, exactly — the chaos plane corrupts at
+  the consuming edge, so nothing is lost between injection and the CRC
+  reject),
+- at least one relay send was chaos-delayed.
+
+Exits nonzero on any failure — this is the ``make chaos-smoke`` CI gate.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/chaos_smoke.py \
+      [--updates 8] [--base-port 28400]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# kill fires once the fleet is warming up (t0 = first supervisor poll);
+# corrupt targets the rollout channel at the storage edge; the delay rides
+# ~20% of the manager's forward sends. Probabilities are low enough that
+# the learner still converges on its data budget.
+DEFAULT_SPEC = (
+    "kill:worker-0-1@t+6s,corrupt:rollout@p=0.02,delay:manager@10ms@p=0.2"
+)
+
+
+def _counter(source: dict, name: str) -> float:
+    return sum(
+        v for n, _labels, v in source.get("counters", ()) if n == name
+    )
+
+
+def _role_total(tele: dict, role: str, name: str) -> float:
+    return sum(
+        _counter(s, name) for s in tele["sources"] if s.get("role") == role
+    )
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--updates", type=int, default=8)
+    p.add_argument("--base-port", type=int, default=28400)
+    p.add_argument("--chaos-spec", default=DEFAULT_SPEC)
+    p.add_argument("--timeout", type=float, default=300.0)
+    args = p.parse_args()
+
+    from tpu_rl.config import MachinesConfig, WorkerMachine
+    from tpu_rl.runtime.runner import local_cluster
+    from tests.conftest import small_config  # the CI-sized Config recipe
+
+    run_dir = tempfile.mkdtemp(prefix="chaos_smoke_")
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        worker_step_sleep=0.0,
+        learner_device="cpu",
+        rollout_lag_sec=30.0,
+        time_horizon=100,
+        loss_log_interval=2,
+        result_dir=run_dir,
+        telemetry_interval_s=0.5,
+        telemetry_stale_s=120.0,
+        supervise_poll_s=0.5,
+        chaos_spec=args.chaos_spec,
+        chaos_seed=7,
+    )
+    machines = MachinesConfig(
+        learner_ip="127.0.0.1",
+        learner_port=args.base_port,
+        workers=[WorkerMachine(
+            num_p=2, manager_ip="127.0.0.1", ip="127.0.0.1",
+            port=args.base_port + 5,
+        )],
+    )
+    print(
+        f"[chaos-smoke] cluster up; run_dir={run_dir} "
+        f"spec={args.chaos_spec!r}", flush=True,
+    )
+    sup = local_cluster(cfg, machines, max_updates=args.updates)
+    failures: list[str] = []
+    # loop() owns supervision: chaos injection, restart-on-death, telemetry.
+    # It sets stop_event itself once the learner exits cleanly.
+    loop_thread = threading.Thread(target=sup.loop, daemon=True)
+    loop_thread.start()
+    try:
+        if not sup.stop_event.wait(args.timeout):
+            failures.append(
+                f"fleet did not complete within {args.timeout:.0f}s"
+            )
+        loop_thread.join(10.0)
+        learner = next(c for c in sup.children if c.name == "learner")
+        learner.proc.join(30.0)
+        if learner.proc.is_alive() or learner.proc.exitcode != 0:
+            failures.append(
+                f"learner did not complete cleanly under chaos "
+                f"(alive={learner.proc.is_alive()}, "
+                f"exitcode={learner.proc.exitcode})"
+            )
+        restarts = sum(c.restarts for c in sup.children)
+        if restarts < 1:
+            failures.append(
+                "no supervised restart happened — the chaos kill never "
+                "landed or the supervisor missed it"
+            )
+        else:
+            print(
+                f"[chaos-smoke] supervised restarts: {restarts}", flush=True
+            )
+    finally:
+        sup.stop()
+
+    tele_path = os.path.join(run_dir, "telemetry.json")
+    try:
+        tele = json.loads(open(tele_path).read())
+    except (OSError, ValueError) as e:
+        failures.append(f"telemetry.json invalid: {type(e).__name__}: {e}")
+        tele = {"sources": []}
+
+    kills = _role_total(tele, "supervisor", "chaos-process-kills")
+    sup_restarts = _role_total(tele, "supervisor", "supervisor-restarts")
+    if kills < 1:
+        failures.append(f"chaos-process-kills={kills}, expected >= 1")
+    if sup_restarts < 1:
+        failures.append(
+            f"supervisor-restarts={sup_restarts} in telemetry, expected >= 1"
+        )
+
+    # Fault accounting: the chaos plane corrupts rollout frames at the
+    # storage edge, where the decode CRC rejects them in the SAME recv call
+    # — so the fleet-wide rejected total must equal the injected count
+    # exactly (no other source of corruption exists in a healthy run).
+    corrupted = _role_total(tele, "storage", "chaos-corrupted-frames")
+    rejected = sum(
+        _role_total(tele, role, f"{role}-rejected-frames")
+        for role in ("worker", "manager", "storage")
+    )
+    if corrupted < 1:
+        failures.append(
+            "chaos corrupted zero frames — the injection shim never fired"
+        )
+    if corrupted != rejected:
+        failures.append(
+            f"fault accounting mismatch: injected {corrupted} corruptions "
+            f"but the fleet rejected {rejected} frames"
+        )
+    else:
+        print(
+            f"[chaos-smoke] fault accounting: {corrupted:.0f} injected == "
+            f"{rejected:.0f} rejected", flush=True,
+        )
+    delayed = _role_total(tele, "manager", "chaos-delayed-frames")
+    if delayed < 1:
+        failures.append(f"chaos-delayed-frames={delayed}, expected >= 1")
+    else:
+        print(f"[chaos-smoke] delayed sends: {delayed:.0f}", flush=True)
+
+    if failures:
+        for f in failures:
+            print(f"[chaos-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[chaos-smoke] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

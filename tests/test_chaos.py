@@ -1,0 +1,546 @@
+"""Chaos plane: fault-plan grammar, deterministic injectors, supervisor
+backoff/budget hardening, and the disabled path's zero-allocation pin."""
+
+import time
+import tracemalloc
+
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.chaos import (
+    FaultPlan,
+    ProcessChaos,
+    ServiceChaos,
+    TransportChaos,
+    maybe_service_chaos,
+    maybe_transport_chaos,
+    site_seed,
+)
+from tpu_rl.runtime.protocol import Protocol, decode, encode
+from tpu_rl.runtime.transport import Pub, Sub
+
+BASE_PORT = 29160
+
+
+# ----------------------------------------------------------------- grammar
+class TestFaultPlan:
+    def test_full_spec_parses(self):
+        plan = FaultPlan.parse(
+            "kill:worker-0-1@t+3s,corrupt:rollout@p=0.01,"
+            "delay:manager@50ms,hang:storage@t+5s,"
+            "stall:inference@200ms@p=0.5,refuse:inference@p=0.1,"
+            "drop:model@p=0.2"
+        )
+        assert len(plan.faults) == 7
+        kill = plan.process_faults()[0]
+        assert (kill.action, kill.target, kill.at_s) == ("kill", "worker-0-1", 3.0)
+
+    def test_corrupt_resolves_to_consuming_edge(self):
+        plan = FaultPlan.parse("corrupt:rollout@p=0.5")
+        send_f, recv_f = plan.transport_faults("storage")
+        assert send_f == []
+        f = recv_f[0]
+        assert f.site == "storage" and f.direction == "recv"
+        assert f.protos == frozenset(
+            {int(Protocol.Rollout), int(Protocol.RolloutBatch)}
+        )
+        # The model channel's consuming edge is the worker SUB.
+        plan = FaultPlan.parse("drop:model@p=0.5")
+        _, recv_f = plan.transport_faults("worker")
+        assert recv_f[0].protos == frozenset({int(Protocol.Model)})
+
+    def test_delay_direction_per_role(self):
+        plan = FaultPlan.parse("delay:manager@10ms,delay:storage@5ms@p=0.5")
+        send_f, _ = plan.transport_faults("manager")
+        assert send_f[0].p == 1.0  # unqualified delay hits every frame
+        _, recv_f = plan.transport_faults("storage")
+        assert recv_f[0].direction == "recv" and recv_f[0].p == 0.5
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "",
+            "explode:worker@t+1s",  # unknown action
+            "kill:worker",  # process fault without a fire time
+            "corrupt:worker@p=0.1",  # corrupt targets a channel, not a role
+            "corrupt:rollout",  # corrupt without probability
+            "corrupt:rollout@p=0",  # probability out of (0, 1]
+            "corrupt:rollout@p=1.5",
+            "delay:rollout@10ms",  # delay targets a role, not a channel
+            "delay:manager",  # delay without latency
+            "stall:inference",  # stall without latency
+            "refuse:inference",  # refuse without probability
+            "stall:storage@10ms",  # unknown service
+            "kill:@t+1s",  # empty target
+            "kill",  # no target at all
+        ],
+    )
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_config_validates_spec(self):
+        cfg = small_config(chaos_spec="corrupt:rollout@p=0.1")
+        assert cfg.chaos_spec is not None
+        with pytest.raises(ValueError):
+            small_config(chaos_spec="corrupt:rollout")
+
+    def test_site_seed_stable_and_distinct(self):
+        assert site_seed(7, "storage") == site_seed(7, "storage")
+        assert site_seed(7, "storage") != site_seed(7, "worker")
+        assert site_seed(7, "worker", 0) != site_seed(7, "worker", 1)
+        assert site_seed(7, "storage") != site_seed(8, "storage")
+
+
+# --------------------------------------------------------------- injectors
+def _chaos_for(spec: str, site: str, **kw):
+    cfg = small_config(chaos_spec=spec, chaos_seed=3)
+    send_f, recv_f = FaultPlan.parse(spec).transport_faults(site)
+    return TransportChaos(
+        send_f, recv_f, seed=site_seed(cfg.chaos_seed, site), **kw
+    )
+
+
+class TestTransportChaos:
+    def test_corrupt_always_breaks_decode(self):
+        chaos = _chaos_for("corrupt:rollout@p=1.0", "storage")
+        for i in range(50):
+            parts = chaos.on_recv(encode(Protocol.Rollout, {"i": i}))
+            with pytest.raises(ValueError):
+                decode(parts)
+        assert chaos.n_corrupted == 50
+
+    def test_corrupt_filters_by_proto(self):
+        chaos = _chaos_for("corrupt:rollout@p=1.0", "storage")
+        parts = chaos.on_recv(encode(Protocol.Stat, 1.0))
+        assert decode(parts) == (Protocol.Stat, 1.0)  # stat frames untouched
+        assert chaos.n_corrupted == 0
+
+    def test_deterministic_across_instances(self):
+        frames = [encode(Protocol.Rollout, {"i": i}) for i in range(30)]
+        a = _chaos_for("corrupt:rollout@p=0.5", "storage")
+        b = _chaos_for("corrupt:rollout@p=0.5", "storage")
+        out_a = [a.on_recv(list(f)) for f in frames]
+        out_b = [b.on_recv(list(f)) for f in frames]
+        assert out_a == out_b
+        assert a.n_corrupted == b.n_corrupted > 0
+
+    def test_drop_swallows_and_counts(self):
+        chaos = _chaos_for("drop:model@p=1.0", "worker")
+        assert chaos.on_recv(encode(Protocol.Model, {"v": 1})) is None
+        assert chaos.n_dropped == 1
+
+    def test_delay_calls_sleep(self):
+        slept = []
+        chaos = _chaos_for("delay:manager@20ms", "manager", sleep=slept.append)
+        parts = encode(Protocol.Rollout, {"x": 1})
+        assert chaos.on_send(list(parts)) == parts  # frame passes unchanged
+        assert slept == [0.02]
+        assert chaos.n_delayed == 1
+
+    def test_factory_returns_none_off_site(self):
+        cfg = small_config(chaos_spec="corrupt:rollout@p=0.5")
+        assert maybe_transport_chaos(cfg, "storage") is not None
+        assert maybe_transport_chaos(cfg, "worker") is None
+        assert maybe_transport_chaos(small_config(), "storage") is None
+
+
+class TestServiceChaos:
+    def test_stall_and_refuse(self):
+        slept = []
+        faults = FaultPlan.parse(
+            "stall:inference@500ms,refuse:inference@p=1.0"
+        ).service_faults()
+        chaos = ServiceChaos(faults, seed=1, sleep=slept.append)
+        chaos.maybe_stall()
+        assert slept == [0.5] and chaos.n_stalled == 1
+        assert chaos.refuse() is True
+        assert chaos.n_refused == 1
+
+    def test_factory_gating(self):
+        assert maybe_service_chaos(small_config()) is None
+        assert (
+            maybe_service_chaos(small_config(chaos_spec="kill:worker@t+1s"))
+            is None
+        )
+        assert (
+            maybe_service_chaos(
+                small_config(chaos_spec="refuse:inference@p=0.5")
+            )
+            is not None
+        )
+
+
+class _FakeProc:
+    def __init__(self, pid=100, alive=True):
+        self.pid = pid
+        self._alive = alive
+        self.exitcode = None
+
+    def is_alive(self):
+        return self._alive
+
+
+class _FakeChild:
+    def __init__(self, name, pid=100, alive=True):
+        self.name = name
+        self.proc = _FakeProc(pid=pid, alive=alive)
+
+
+class TestProcessChaos:
+    def test_fires_once_at_deadline(self):
+        clock = [0.0]
+        kills = []
+        chaos = ProcessChaos.from_spec(
+            "kill:worker-0-1@t+3s",
+            clock=lambda: clock[0],
+            kill=lambda pid, sig: kills.append((pid, sig)),
+        )
+        kids = [_FakeChild("worker-0-0", 10), _FakeChild("worker-0-1", 11)]
+        assert chaos.poll(kids) == []  # t0 anchored on first poll
+        clock[0] = 2.9
+        assert chaos.poll(kids) == []
+        clock[0] = 3.1
+        assert chaos.poll(kids) == [("kill", "worker-0-1")]
+        assert kills == [(11, 9)]  # SIGKILL, the exact-name match
+        assert chaos.poll(kids) == []  # one-shot
+        assert chaos.n_kills == 1
+
+    def test_prefix_match_and_stop_signal(self):
+        clock = [10.0]
+        kills = []
+        chaos = ProcessChaos.from_spec(
+            "hang:worker@t+0s",
+            clock=lambda: clock[0],
+            kill=lambda pid, sig: kills.append((pid, sig)),
+        )
+        kids = [_FakeChild("worker-0-0", 20)]
+        assert chaos.poll(kids) == [("hang", "worker-0-0")]
+        assert kills == [(20, 19)]  # SIGSTOP
+        assert chaos.n_stops == 1
+
+    def test_unmatched_fault_stays_armed(self):
+        clock = [0.0]
+        chaos = ProcessChaos.from_spec(
+            "kill:learner@t+1s", clock=lambda: clock[0], kill=lambda *_: None
+        )
+        dead = [_FakeChild("learner", alive=False)]
+        chaos.poll(dead)
+        clock[0] = 5.0
+        assert chaos.poll(dead) == []  # no live match: retry, don't fire
+        dead[0].proc._alive = True
+        assert chaos.poll(dead) == [("kill", "learner")]
+
+
+# ------------------------------------------------- supervisor backoff/budget
+class _StubProc:
+    """Dead-by-default child proc the mocked-clock Supervisor tests drive."""
+
+    def __init__(self):
+        self._alive = False
+        self.exitcode = 1
+        self.pid = 1234
+
+    def is_alive(self):
+        return self._alive
+
+    def terminate(self):
+        self._alive = False
+
+    def kill(self):
+        self._alive = False
+
+    def join(self, timeout=None):
+        pass
+
+
+def _mock_supervisor(clock, **kw):
+    from tpu_rl.runtime.runner import Child, Supervisor
+
+    sup = Supervisor(
+        heartbeat_timeout=10.0,
+        startup_grace=0.0,
+        max_restarts=kw.pop("max_restarts", 3),
+        restart_window_s=kw.pop("restart_window_s", 100.0),
+        backoff_s=1.0,
+        backoff_max_s=8.0,
+        clock=lambda: clock[0],
+        **kw,
+    )
+    child = Child(
+        name="crashy",
+        target=lambda: None,
+        args=(),
+        proc=_StubProc(),
+        heartbeat=type("HB", (), {"value": clock[0]})(),
+        cpu_only=True,
+    )
+    child.started_at = clock[0]
+    sup.children.append(child)
+    starts = []
+
+    def fake_start(c):
+        c.proc = _StubProc()
+        c.proc._alive = True
+        c.started_at = clock[0]
+        c.heartbeat.value = clock[0]
+        starts.append(clock[0])
+
+    sup._start = fake_start
+    return sup, child, starts
+
+
+class TestSupervisorBackoff:
+    def test_first_crash_restarts_instantly(self):
+        clock = [100.0]
+        sup, child, starts = _mock_supervisor(clock)
+        assert sup.check() == ["crashy"]
+        assert child.restarts == 1 and child.streak == 1
+        assert starts == [100.0]
+
+    def test_streak_backs_off_exponentially(self):
+        clock = [100.0]
+        sup, child, starts = _mock_supervisor(clock)
+        sup.check()  # crash 1: instant
+        child.proc._alive = False  # crashes again right away
+        clock[0] = 101.0
+        assert sup.check() == []  # crash 2: scheduled, not respawned
+        assert child.respawn_at == pytest.approx(102.0)  # +backoff_s * 2^0
+        clock[0] = 101.5
+        assert sup.check() == []  # still waiting out the delay
+        clock[0] = 102.5
+        assert sup.check() == ["crashy"]
+        assert child.restarts == 2
+        child.proc._alive = False
+        clock[0] = 103.0
+        sup.check()  # crash 3: delay doubles
+        assert child.respawn_at == pytest.approx(103.0 + 2.0)
+
+    def test_backoff_caps_at_max(self):
+        clock = [0.0]
+        sup, child, _ = _mock_supervisor(clock, max_restarts=100)
+        sup.check()
+        for _ in range(8):  # deep streak: delay would be 2^7 = 128s uncapped
+            child.proc._alive = False
+            clock[0] += 0.5
+            sup.check()
+            if child.respawn_at:
+                clock[0] = child.respawn_at
+                sup.check()
+        assert child.streak >= 8
+        child.proc._alive = False
+        clock[0] += 0.5
+        sup.check()
+        assert child.respawn_at - clock[0] == pytest.approx(8.0)  # backoff_max_s
+
+    def test_healthy_window_resets_streak(self):
+        clock = [100.0]
+        sup, child, _ = _mock_supervisor(clock, restart_window_s=50.0)
+        sup.check()
+        child.proc._alive = False
+        clock[0] = 101.0
+        sup.check()
+        assert child.streak == 2
+        clock[0] = child.respawn_at
+        sup.check()  # respawned; now it runs healthy for a full window
+        child.proc._alive = False
+        clock[0] += 60.0  # > restart_window_s since started_at
+        assert sup.check() == ["crashy"]  # instant again: streak reset
+        assert child.streak == 1
+
+    def test_budget_exhaustion_within_window(self):
+        clock = [0.0]
+        sup, child, _ = _mock_supervisor(clock, max_restarts=2)
+        for _ in range(4):
+            child.proc._alive = False
+            sup.check()
+            if child.respawn_at:
+                clock[0] = child.respawn_at
+                sup.check()
+            clock[0] += 1.0
+            if child.exhausted:
+                break
+        assert child.exhausted
+        assert child.restarts == 2  # budget spent, then declared dead
+
+    def test_zero_budget_exhausts_immediately(self):
+        clock = [0.0]
+        sup, child, starts = _mock_supervisor(clock, max_restarts=0)
+        assert sup.check() == []
+        assert child.exhausted and starts == []
+
+    def test_from_config_maps_fields(self):
+        from tpu_rl.runtime.runner import Supervisor
+
+        cfg = small_config(
+            heartbeat_timeout_s=7.0,
+            startup_grace_s=1.0,
+            supervise_poll_s=0.25,
+            max_restarts=5,
+            restart_window_s=60.0,
+            restart_backoff_s=0.5,
+            restart_backoff_max_s=4.0,
+            chaos_spec="kill:worker@t+1s",
+        )
+        sup = Supervisor.from_config(cfg)
+        assert sup.heartbeat_timeout == 7.0
+        assert sup.startup_grace == 1.0
+        assert sup.poll_s == 0.25
+        assert sup.max_restarts == 5
+        assert sup.restart_window_s == 60.0
+        assert sup.backoff_s == 0.5
+        assert sup.backoff_max_s == 4.0
+        assert sup.chaos is not None and len(sup.chaos.faults) == 1
+
+
+# ------------------------------------------------------------- cli plumbing
+def test_cli_chaos_flags_override_config():
+    from tpu_rl.__main__ import build_parser, load_config
+
+    args = build_parser().parse_args(
+        [
+            "local",
+            "--chaos-spec", "corrupt:rollout@p=0.1",
+            "--chaos-seed", "42",
+            "--heartbeat-timeout", "15",
+            "--startup-grace", "30",
+            "--supervise-poll", "0.5",
+            "--max-restarts", "9",
+        ]
+    )
+    cfg, _ = load_config(args)
+    assert cfg.chaos_spec == "corrupt:rollout@p=0.1"
+    assert cfg.chaos_seed == 42
+    assert cfg.heartbeat_timeout_s == 15.0
+    assert cfg.startup_grace_s == 30.0
+    assert cfg.supervise_poll_s == 0.5
+    assert cfg.max_restarts == 9
+
+
+def test_cli_defaults_leave_config_untouched():
+    from tpu_rl.__main__ import build_parser, load_config
+
+    cfg, _ = load_config(build_parser().parse_args(["local"]))
+    assert cfg.chaos_spec is None
+    assert cfg.max_restarts == 3
+
+
+# ----------------------------------------------------------- wire integration
+@pytest.mark.timeout(60)
+def test_corrupt_injection_accounts_exactly_over_zmq():
+    """Every injected corruption yields exactly one n_rejected in the same
+    recv — the invariant the chaos-smoke fleet accounting check rests on."""
+    cfg = small_config(chaos_spec="corrupt:rollout@p=1.0", chaos_seed=11)
+    chaos = maybe_transport_chaos(cfg, "storage")
+    port = BASE_PORT
+    sub = Sub("127.0.0.1", port, bind=True, chaos=chaos)
+    pub = Pub("127.0.0.1", port, bind=False)
+    try:
+        # PUB/SUB slow-joiner: ping on the (uncorrupted) stat proto until
+        # the subscription propagates — a fixed sleep flakes on slow hosts.
+        for _ in range(100):
+            pub.send(Protocol.Stat, -1.0)
+            if sub.recv_traced(timeout_ms=100) is not None:
+                break
+        else:
+            pytest.fail("subscription never propagated")
+        assert sub.n_rejected == 0  # stat pings decode fine
+        n_sent = 8
+        for i in range(n_sent):
+            pub.send(Protocol.Rollout, {"i": i})
+        got = [sub.recv_traced(timeout_ms=2000) for _ in range(n_sent)]
+        assert got == [None] * n_sent  # every rollout frame rejected
+        assert sub.n_rejected == chaos.n_corrupted == n_sent
+        # Control frames on other protos still flow.
+        pub.send(Protocol.Stat, 3.5)
+        msg = sub.recv_traced(timeout_ms=2000)
+        assert msg is not None and msg[0] == Protocol.Stat
+        assert sub.n_rejected == chaos.n_corrupted  # stat not counted
+    finally:
+        pub.close()
+        sub.close()
+
+
+@pytest.mark.timeout(60)
+def test_sub_survives_truncated_multipart():
+    """A SIGKILL cannot truncate a zmq multipart frame (sends are atomic),
+    but the storage edge must survive garbage anyway: short frames, bare
+    proto bytes, and junk bodies are rejected + counted, never raised —
+    then a valid frame still decodes."""
+    port = BASE_PORT + 1
+    sub = Sub("127.0.0.1", port, bind=True)
+    import zmq
+
+    ctx = zmq.Context.instance()
+    raw = ctx.socket(zmq.PUB)
+    raw.connect(f"tcp://127.0.0.1:{port}")
+    try:
+        # Slow-joiner: ping with valid frames until the subscription lands.
+        for _ in range(100):
+            raw.send_multipart(encode(Protocol.Stat, -1.0))
+            if sub.recv(timeout_ms=100) is not None:
+                break
+        else:
+            pytest.fail("subscription never propagated")
+        assert sub.n_rejected == 0
+        raw.send_multipart([bytes([int(Protocol.Rollout)])])  # 1 part only
+        raw.send_multipart([b"\x01", b"garbage-no-header"])
+        raw.send_multipart([b"", b""])
+        raw.send_multipart(encode(Protocol.Rollout, {"ok": 1}))
+        deadline = time.time() + 10.0
+        msg = None
+        while msg is None and time.time() < deadline:
+            msg = sub.recv(timeout_ms=500)
+        assert msg is not None
+        assert msg[0] == Protocol.Rollout and msg[1] == {"ok": 1}
+        assert sub.n_rejected == 3
+    finally:
+        raw.close(linger=0)
+        sub.close()
+
+
+# ------------------------------------------------------------ zero-cost pin
+class _NullSock:
+    """Socket stand-in so tracemalloc sees ONLY the wrapper's own work."""
+
+    def __init__(self, frame=None):
+        self._frame = frame
+
+    def send_multipart(self, parts, flags=0):
+        pass
+
+    def recv_multipart(self, flags=0):
+        return self._frame
+
+
+def test_disabled_chaos_path_allocates_nothing():
+    """chaos=None must keep the transport hot loop allocation-free: the
+    whole feature costs one `is None` check per frame when off."""
+    frame = encode(Protocol.Rollout, {"x": 1.0})
+    pub = Pub.__new__(Pub)
+    pub._chaos = None
+    pub.sock = _NullSock()
+    sub = Sub.__new__(Sub)
+    sub._chaos = None
+    sub.n_rejected = 0
+    sub.sock = _NullSock(frame)
+
+    def hot_loop(n):
+        for _ in range(n):
+            pub.send_raw(frame)
+            sub.recv_raw()
+
+    hot_loop(50)  # warm every lazy structure (peek caches, enum lookups)
+    tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot()
+        hot_loop(500)
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    flt = [tracemalloc.Filter(True, "*runtime/transport.py")]
+    stats = after.filter_traces(flt).compare_to(
+        before.filter_traces(flt), "lineno"
+    )
+    grown = [s for s in stats if s.size_diff > 0]
+    assert not grown, [str(s) for s in grown]

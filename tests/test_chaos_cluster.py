@@ -1,0 +1,86 @@
+"""Supervised-recovery edge paths with REAL child processes: the hung-but-
+alive (SIGSTOP) silence-kill escalation, and restart-budget exhaustion
+winding down the fleet cleanly via loop()."""
+
+import os
+import signal
+import time
+
+import pytest
+
+
+def _beat_main(stop_event, heartbeat):
+    """Healthy child: heartbeats until told to stop."""
+    while not stop_event.is_set():
+        heartbeat.value = time.time()
+        time.sleep(0.05)
+
+
+def _crash_main(stop_event, heartbeat):
+    raise RuntimeError("chaos-cluster crasher")
+
+
+@pytest.mark.timeout(180)
+def test_sigstop_child_is_silence_killed_and_respawned(tmp_path):
+    """SIGSTOP leaves a child alive to the OS but silent to the heartbeat
+    plane. The supervisor must declare it hung, escalate past the pending
+    SIGTERM (terminate() never lands on a stopped process) to SIGKILL, and
+    respawn — the exact sequence a chaos `hang:` fault exercises."""
+    from tpu_rl.runtime.runner import Supervisor
+
+    sup = Supervisor(
+        heartbeat_timeout=2.0,
+        startup_grace=0.0,
+        log_root=str(tmp_path / "logs"),
+    )
+    child = sup.spawn("beater", _beat_main, cpu_only=True)
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not (
+            child.proc.is_alive() and child.heartbeat.value > 0
+        ):
+            time.sleep(0.1)
+        assert child.proc.is_alive(), "child never came up"
+        old_pid = child.proc.pid
+
+        os.kill(old_pid, signal.SIGSTOP)  # hung, not dead
+        deadline = time.time() + 90
+        while time.time() < deadline and child.restarts == 0:
+            sup.check()
+            time.sleep(0.3)
+        assert child.restarts == 1, "silent child was never respawned"
+        assert child.proc.pid != old_pid
+        # The replacement is healthy: its heartbeat advances.
+        hb0 = child.heartbeat.value
+        deadline = time.time() + 60
+        while time.time() < deadline and child.heartbeat.value <= hb0:
+            time.sleep(0.1)
+        assert child.heartbeat.value > hb0, "respawned child never beat"
+    finally:
+        sup.stop()
+
+
+@pytest.mark.timeout(180)
+def test_budget_exhaustion_stops_fleet_cleanly(tmp_path):
+    """A crash-looping child burns its windowed budget (with backoff between
+    respawns), after which loop() declares it exhausted, sets the fleet
+    stop event, and RETURNS — no hot-loop, no hang."""
+    from tpu_rl.runtime.runner import Supervisor
+
+    sup = Supervisor(
+        max_restarts=2,
+        restart_window_s=120.0,
+        backoff_s=0.1,
+        backoff_max_s=0.5,
+        poll_s=0.1,
+        log_root=str(tmp_path / "logs"),
+    )
+    child = sup.spawn("crasher", _crash_main, cpu_only=True)
+    try:
+        sup.loop()  # must return on its own
+        assert child.exhausted
+        assert sup.stop_event.is_set()
+        assert child.restarts == 2  # budget fully spent before giving up
+        assert not child.proc.is_alive()
+    finally:
+        sup.stop()

@@ -43,6 +43,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-sample-n", type=int, default=None,
                    help="sample every Nth worker tick into the fleet trace "
                    "(result_dir/fleet_trace.json); 0/unset = off")
+    p.add_argument("--chaos-spec", default=None,
+                   help="deterministic fault plan, e.g. "
+                   "'kill:worker-0-1@t+3s,corrupt:rollout@p=0.01,"
+                   "delay:manager@50ms' (see tpu_rl.chaos.plan)")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="seed for the chaos plane's per-site RNG streams")
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   help="seconds of child-heartbeat silence before the "
+                   "supervisor declares it hung and restarts it")
+    p.add_argument("--startup-grace", type=float, default=None,
+                   help="seconds after spawn before silence counts "
+                   "(covers jit compile / env build)")
+    p.add_argument("--supervise-poll", type=float, default=None,
+                   help="supervisor health-check interval in seconds")
+    p.add_argument("--max-restarts", type=int, default=None,
+                   help="restarts allowed per child within restart_window_s "
+                   "before the fleet shuts down")
     return p
 
 
@@ -59,6 +76,18 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["telemetry_port"] = args.telemetry_port
     if args.trace_sample_n is not None:
         overrides["trace_sample_n"] = args.trace_sample_n
+    if args.chaos_spec is not None:
+        overrides["chaos_spec"] = args.chaos_spec
+    if args.chaos_seed is not None:
+        overrides["chaos_seed"] = args.chaos_seed
+    if args.heartbeat_timeout is not None:
+        overrides["heartbeat_timeout_s"] = args.heartbeat_timeout
+    if args.startup_grace is not None:
+        overrides["startup_grace_s"] = args.startup_grace
+    if args.supervise_poll is not None:
+        overrides["supervise_poll_s"] = args.supervise_poll
+    if args.max_restarts is not None:
+        overrides["max_restarts"] = args.max_restarts
     if overrides:
         cfg = cfg.replace(**overrides)
     machines = (

@@ -1,0 +1,26 @@
+"""Deterministic fault injection for the actor-learner fleet.
+
+See ``tpu_rl.chaos.plan`` for the fault-plan grammar. The subsystem is
+entirely off-path unless ``Config.chaos_spec`` is set.
+"""
+
+from tpu_rl.chaos.inject import (
+    ServiceChaos,
+    TransportChaos,
+    maybe_service_chaos,
+    maybe_transport_chaos,
+    site_seed,
+)
+from tpu_rl.chaos.plan import Fault, FaultPlan
+from tpu_rl.chaos.process import ProcessChaos
+
+__all__ = [
+    "Fault",
+    "FaultPlan",
+    "ProcessChaos",
+    "ServiceChaos",
+    "TransportChaos",
+    "maybe_service_chaos",
+    "maybe_transport_chaos",
+    "site_seed",
+]

@@ -1,0 +1,166 @@
+"""Transport- and service-level fault injectors.
+
+``TransportChaos`` shims one role's ``Pub``/``Sub`` sockets; ``ServiceChaos``
+hooks the inference service's flush/reply path. Both are seeded per
+``(chaos_seed, site, instance)`` with a salt-free hash (``zlib.crc32`` —
+Python's ``hash()`` is salted per process and would break cross-process
+determinism), so a fleet run replays exactly from the config alone.
+
+Corruption flips one byte of the wire frame *past* the 12-byte protocol
+header, guaranteeing a CRC mismatch at ``decode()`` — i.e. every injected
+corruption yields exactly one ``n_rejected`` in the same recv call that
+injected it. That same-call pairing is what makes the chaos-smoke
+accounting check (`injected == fleet rejected delta`) exact rather than
+eventually-consistent.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+
+import numpy as np
+
+from tpu_rl.chaos.plan import Fault, FaultPlan
+from tpu_rl.runtime.protocol import _HEADER
+
+# XOR mask for corruption: any nonzero delta breaks the body CRC.
+_FLIP = 0x5A
+
+
+def site_seed(chaos_seed: int, site: str, instance: int = 0) -> int:
+    """Deterministic per-socket-owner seed, stable across processes."""
+    return (int(chaos_seed) & 0xFFFFFFFF) ^ zlib.crc32(
+        f"{site}/{instance}".encode()
+    )
+
+
+class TransportChaos:
+    """Per-socket fault shim: mutate/drop/delay multipart frames.
+
+    ``on_send``/``on_recv`` return the (possibly mutated) parts list, or
+    ``None`` to swallow the frame. The transport layer holds ``chaos=None``
+    by default and guards with a single ``is None`` check, so the disabled
+    path stays allocation-free (pinned by a tracemalloc test).
+    """
+
+    __slots__ = (
+        "_send_faults",
+        "_recv_faults",
+        "_rng",
+        "_sleep",
+        "n_corrupted",
+        "n_dropped",
+        "n_delayed",
+    )
+
+    def __init__(
+        self,
+        send_faults: list[Fault],
+        recv_faults: list[Fault],
+        seed: int,
+        sleep=time.sleep,
+    ):
+        self._send_faults = list(send_faults)
+        self._recv_faults = list(recv_faults)
+        self._rng = np.random.default_rng(seed)
+        self._sleep = sleep
+        self.n_corrupted = 0
+        self.n_dropped = 0
+        self.n_delayed = 0
+
+    def on_send(self, parts):
+        return self._apply(self._send_faults, parts)
+
+    def on_recv(self, parts):
+        return self._apply(self._recv_faults, parts)
+
+    def _apply(self, faults, parts):
+        for f in faults:
+            if f.protos is not None and (
+                len(parts) < 2
+                or len(parts[0]) != 1
+                or parts[0][0] not in f.protos
+            ):
+                continue
+            if f.action == "delay":
+                if f.p >= 1.0 or self._rng.random() < f.p:
+                    self.n_delayed += 1
+                    self._sleep(f.delay_ms / 1e3)
+            elif f.action == "drop":
+                if self._rng.random() < f.p:
+                    self.n_dropped += 1
+                    return None
+            elif f.action == "corrupt":
+                if self._rng.random() < f.p and len(parts) >= 2:
+                    parts = self._corrupt(parts)
+                    self.n_corrupted += 1
+        return parts
+
+    def _corrupt(self, parts):
+        body = bytearray(parts[1])
+        if not body:
+            return parts  # already malformed; decode rejects it as-is
+        # Flip a byte past the header so peek() (header-only validation at
+        # the relay) passes but the body CRC at decode() fails.
+        lo = _HEADER.size if len(body) > _HEADER.size else 0
+        idx = lo + int(self._rng.integers(len(body) - lo))
+        body[idx] ^= _FLIP
+        out = list(parts)
+        out[1] = bytes(body)
+        return out
+
+
+class ServiceChaos:
+    """Inference-service faults: pre-flush stalls and swallowed replies."""
+
+    __slots__ = ("_stalls", "_refusals", "_rng", "_sleep", "n_stalled", "n_refused")
+
+    def __init__(self, faults: list[Fault], seed: int, sleep=time.sleep):
+        self._stalls = [f for f in faults if f.action == "stall"]
+        self._refusals = [f for f in faults if f.action == "refuse"]
+        self._rng = np.random.default_rng(seed)
+        self._sleep = sleep
+        self.n_stalled = 0
+        self.n_refused = 0
+
+    def maybe_stall(self) -> None:
+        """Called once per batch flush."""
+        for f in self._stalls:
+            if f.p >= 1.0 or self._rng.random() < f.p:
+                self.n_stalled += 1
+                self._sleep(f.delay_ms / 1e3)
+
+    def refuse(self) -> bool:
+        """Called once per reply; True means swallow it (client times out)."""
+        for f in self._refusals:
+            if self._rng.random() < f.p:
+                self.n_refused += 1
+                return True
+        return False
+
+
+def maybe_transport_chaos(cfg, site: str, instance: int = 0):
+    """Build a ``TransportChaos`` for one role, or None (the common case)."""
+    spec = getattr(cfg, "chaos_spec", None)
+    if not spec:
+        return None
+    send_f, recv_f = FaultPlan.parse(spec).transport_faults(site)
+    if not send_f and not recv_f:
+        return None
+    return TransportChaos(
+        send_f, recv_f, seed=site_seed(getattr(cfg, "chaos_seed", 0), site, instance)
+    )
+
+
+def maybe_service_chaos(cfg, service: str = "inference"):
+    """Build a ``ServiceChaos`` for one service, or None."""
+    spec = getattr(cfg, "chaos_spec", None)
+    if not spec:
+        return None
+    faults = FaultPlan.parse(spec).service_faults(service)
+    if not faults:
+        return None
+    return ServiceChaos(
+        faults, seed=site_seed(getattr(cfg, "chaos_seed", 0), service)
+    )

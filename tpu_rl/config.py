@@ -280,6 +280,50 @@ class Config:
     # wedges the fleet.
     inference_timeout_ms: int = 2000
     inference_retries: int = 2
+    # Fallback recovery: a fallen-back worker probes the inference service
+    # every `inference_reprobe_s` seconds (single zero-retry request using
+    # the live observation; a reply restores remote acting, a timeout costs
+    # one `inference_timeout_ms` and doubles the interval up to
+    # `inference_reprobe_max_s`). 0 = the old one-way degradation: fall
+    # back once, local forever.
+    inference_reprobe_s: float = 5.0
+    inference_reprobe_max_s: float = 60.0
+    # ---- supervision (tpu_rl.runtime.runner.Supervisor) ----
+    # A child silent (no heartbeat) for `heartbeat_timeout_s` is killed and
+    # respawned; `startup_grace_s` extends the allowance after (re)spawn so
+    # jit warmup/env construction don't read as hangs. The supervisor polls
+    # children every `supervise_poll_s` seconds.
+    heartbeat_timeout_s: float = 60.0
+    startup_grace_s: float = 180.0
+    supervise_poll_s: float = 2.0
+    # Sliding-window restart budget: a child gets at most `max_restarts`
+    # respawns per trailing `restart_window_s` seconds; exceeding it marks
+    # the child exhausted and shuts the fleet down cleanly (a crash-loop is
+    # a bug to surface, not to hide). Within a crash streak, respawn N waits
+    # `restart_backoff_s * 2**(N-2)` seconds (first respawn is immediate),
+    # capped at `restart_backoff_max_s`; a child healthy for a full window
+    # resets its streak.
+    max_restarts: int = 3
+    restart_window_s: float = 300.0
+    restart_backoff_s: float = 1.0
+    restart_backoff_max_s: float = 30.0
+    # ---- chaos plane (tpu_rl.chaos) ----
+    # Deterministic fault plan, e.g.
+    # "kill:worker-0-1@t+3s,corrupt:rollout@p=0.01,delay:manager@50ms".
+    # Grammar and semantics: tpu_rl/chaos/plan.py. None (default) = no
+    # injectors constructed anywhere; every hot-path hook reduces to one
+    # `is None` check.
+    chaos_spec: str | None = None
+    # Base seed for all injectors; each socket/service derives its own
+    # stream via crc32(site/instance), so a run replays from config alone.
+    chaos_seed: int = 0
+    # Learner liveness rebroadcast: when the learner has been idle (no
+    # batch) and nothing was published for `rebroadcast_idle_s` seconds, it
+    # re-publishes current weights + ver. Late-joining and *restarted*
+    # workers (PUB/SUB slow-joiner drops the one-shot initial broadcast)
+    # converge onto the live policy instead of acting stale forever.
+    # 0 = publish only on the update cadence.
+    rebroadcast_idle_s: float = 2.0
     # ---- telemetry plane (tpu_rl.obs) ----
     # HTTP port for the storage-side exporter serving Prometheus text at
     # /metrics and staleness-aware liveness at /healthz. 0 = no server, no
@@ -357,6 +401,26 @@ class Config:
         assert self.inference_flush_us >= 0, self.inference_flush_us
         assert self.inference_timeout_ms > 0, self.inference_timeout_ms
         assert self.inference_retries >= 0, self.inference_retries
+        assert self.inference_reprobe_s >= 0, self.inference_reprobe_s
+        assert self.inference_reprobe_max_s >= self.inference_reprobe_s, (
+            f"inference_reprobe_max_s ({self.inference_reprobe_max_s}) must "
+            f"be >= inference_reprobe_s ({self.inference_reprobe_s})"
+        )
+        assert self.heartbeat_timeout_s > 0, self.heartbeat_timeout_s
+        assert self.startup_grace_s >= 0, self.startup_grace_s
+        assert self.supervise_poll_s > 0, self.supervise_poll_s
+        assert self.max_restarts >= 0, self.max_restarts
+        assert self.restart_window_s > 0, self.restart_window_s
+        assert self.restart_backoff_s >= 0, self.restart_backoff_s
+        assert self.restart_backoff_max_s >= 0, self.restart_backoff_max_s
+        assert self.rebroadcast_idle_s >= 0, self.rebroadcast_idle_s
+        if self.chaos_spec:
+            # Parse-check here so a bad plan fails at config load, not
+            # minutes later inside a spawned child. plan.py is stdlib-only,
+            # so this import stays cheap.
+            from tpu_rl.chaos.plan import FaultPlan
+
+            FaultPlan.parse(self.chaos_spec)
         assert 0 <= self.telemetry_port < 65536, self.telemetry_port
         assert self.telemetry_interval_s > 0, self.telemetry_interval_s
         assert self.telemetry_stale_s > 0, self.telemetry_stale_s

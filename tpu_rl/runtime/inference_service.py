@@ -114,6 +114,15 @@ class InferenceService:
         self.n_rejected_payload = 0
         self.error: BaseException | None = None
         self._jnp = None  # bound by the serve thread (deferred jax import)
+        # Service-level fault injection (tpu_rl.chaos): stall:inference
+        # sleeps before a batch flush, refuse:inference swallows replies so
+        # clients time out — exercising the worker fallback + re-probe
+        # path. None unless cfg.chaos_spec names this service.
+        self.chaos = None
+        if getattr(cfg, "chaos_spec", None):
+            from tpu_rl.chaos import maybe_service_chaos
+
+            self.chaos = maybe_service_chaos(cfg)
 
     # --------------------------------------------------------------- control
     def start(self) -> "InferenceService":
@@ -286,6 +295,8 @@ class InferenceService:
     # ----------------------------------------------------------------- flush
     def _flush(self, router, step, chunk, rows, pad_rows, key,
                store_carry, jnp) -> None:
+        if self.chaos is not None:
+            self.chaos.maybe_stall()
         t0 = time.perf_counter()
         obs = np.zeros((pad_rows, chunk[0].obs.shape[1]), np.float32)
         first = np.ones((pad_rows,), np.float32)  # pad slots: reset carry
@@ -336,6 +347,12 @@ class InferenceService:
             if store_carry:
                 reply["hx"] = h_pre_np[off:off + n]
                 reply["cx"] = c_pre_np[off:off + n]
+            if self.chaos is not None and self.chaos.refuse():
+                # Swallowed reply: the client burns a timeout and retries /
+                # falls back. n_replies stays honest — it counts replies
+                # actually sent. The carry above already advanced, the same
+                # smudge a genuinely lost reply leaves (see InferenceClient).
+                continue
             router.send(req.identity, Protocol.Act, reply)
             self.n_replies += 1
         self.n_batches += 1
@@ -389,12 +406,23 @@ class InferenceClient:
         model SUB."""
         return self.dealer.n_rejected
 
-    def act(self, obs: np.ndarray, first: np.ndarray) -> dict | None:
+    def act(
+        self,
+        obs: np.ndarray,
+        first: np.ndarray,
+        retries: int | None = None,
+    ) -> dict | None:
+        """``retries`` overrides ``Config.inference_retries`` for this call
+        (the worker's re-probe uses 0: one cheap attempt, not a full retry
+        burst against a possibly-still-dead server)."""
         cfg = self.cfg
+        attempts = (
+            cfg.inference_retries if retries is None else int(retries)
+        ) + 1
         req = {"wid": self.wid, "seq": self.seq, "obs": obs, "first": first}
         t0 = time.perf_counter()
         try:
-            for _attempt in range(cfg.inference_retries + 1):
+            for _attempt in range(attempts):
                 self.dealer.send(Protocol.ObsRequest, req)
                 deadline = time.perf_counter() + cfg.inference_timeout_ms / 1e3
                 while True:

@@ -181,6 +181,10 @@ class LearnerService:
         self._publisher: AsyncPublisher | None = None
         self._inference = None  # InferenceService when act_mode="remote"
         self._tracer = None  # TraceRecorder when result_dir is set
+        # Idle-rebroadcast odometer: model publishes fired from the starving
+        # branch (no fresh update) so late-joining or restarted workers stop
+        # acting on a stale/random policy (chaos-plane hardening).
+        self.n_rebroadcasts = 0
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -304,7 +308,14 @@ class LearnerService:
                     "max_updates budget; anneal disabled", flush=True,
                 )
 
-        pub = Pub("*", self.model_port, bind=True, hwm=MODEL_HWM)
+        # Fault injection (tpu_rl.chaos): delay:learner shims the model
+        # broadcast sends. None unless a chaos_spec names this site.
+        chaos = None
+        if cfg.chaos_spec:
+            from tpu_rl.chaos import maybe_transport_chaos
+
+            chaos = maybe_transport_chaos(cfg, "learner")
+        pub = Pub("*", self.model_port, bind=True, hwm=MODEL_HWM, chaos=chaos)
         # Async broadcast rides the same switch as the feed pipeline so
         # learner_prefetch=0 is a FULLY serial A/B baseline.
         self._publisher = (
@@ -372,6 +383,7 @@ class LearnerService:
         # First broadcast so workers act with the resumed/initial policy
         # rather than their own random init.
         self._publish(pub, state, ver=start_idx)
+        last_pub_m = time.monotonic()
 
         if (
             self.max_updates is not None
@@ -410,6 +422,26 @@ class LearnerService:
                 if item is None:
                     if self.heartbeat is not None:
                         self.heartbeat.value = time.time()
+                    # Idle rebroadcast (chaos-plane hardening): a PUB frame
+                    # is lost to any SUB that connected after the send
+                    # (slow-joiner), so a worker restarted by the supervisor
+                    # — or a learner restarted mid-run — would act on a
+                    # stale/random policy until the next update-driven
+                    # publish. While the store starves, re-ship the current
+                    # weights + ver on a slow clock so joiners converge.
+                    if cfg.rebroadcast_idle_s > 0:
+                        now_m = time.monotonic()
+                        if now_m - last_pub_m >= cfg.rebroadcast_idle_s:
+                            self._publish(pub, state, ver=idx)
+                            last_pub_m = time.monotonic()
+                            self.n_rebroadcasts += 1
+                    if telem_reg is not None:
+                        now_m = time.monotonic()
+                        if now_m - telem_last >= cfg.telemetry_interval_s:
+                            telem_last = now_m
+                            self._emit_telemetry(
+                                telem_reg, telem_pub, timer, idx
+                            )
                     if feed.poll_sleep:
                         time.sleep(feed.poll_sleep)
                     continue
@@ -477,6 +509,7 @@ class LearnerService:
                         profiling = False
                 if _crossed(prev_idx, idx, self.publish_interval):
                     self._publish(pub, state, ver=idx)
+                    last_pub_m = time.monotonic()
                 if telem_reg is not None:
                     now_m = time.monotonic()
                     if now_m - telem_last >= cfg.telemetry_interval_s:
@@ -703,11 +736,19 @@ class LearnerService:
         reg.gauge(LEARNER_VERSION_GAUGE).set(idx)
         for name, val in timer.scalars().items():
             reg.gauge(name).set(val)
+        reg.counter("learner-rebroadcasts").set_total(self.n_rebroadcasts)
         svc = self._inference
         if svc is not None:
             reg.counter("inference-requests").set_total(svc.n_requests)
             reg.counter("inference-replies").set_total(svc.n_replies)
             reg.counter("inference-batches").set_total(svc.n_batches)
+            if svc.chaos is not None:
+                reg.counter("inference-chaos-stalls").set_total(
+                    svc.chaos.n_stalled
+                )
+                reg.counter("inference-chaos-refusals").set_total(
+                    svc.chaos.n_refused
+                )
         pub.send(Protocol.Telemetry, reg.snapshot())
 
     def _log_fleet_stat(self, logger: LearnerLogger) -> None:

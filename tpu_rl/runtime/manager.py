@@ -86,8 +86,15 @@ class Manager:
         self._trace_path = None
 
     def run(self) -> None:
-        sub = self._sub = Sub("*", self.worker_port, bind=True)
-        pub = Pub(*self.learner_addr, bind=False)
+        # Fault injection (tpu_rl.chaos): delay:manager shims the forward
+        # sends to storage. None unless a chaos_spec names this site.
+        chaos = None
+        if self.cfg.chaos_spec:
+            from tpu_rl.chaos import maybe_transport_chaos
+
+            chaos = maybe_transport_chaos(self.cfg, "manager")
+        sub = self._sub = Sub("*", self.worker_port, bind=True, chaos=chaos)
+        pub = Pub(*self.learner_addr, bind=False, chaos=chaos)
         recv = sub.recv_raw if self.raw else sub.recv_traced
 
         # Telemetry (tpu_rl.obs): the relay's own health snapshot, emitted
@@ -147,7 +154,20 @@ class Manager:
                     registry.counter("manager-stats-seen").set_total(
                         self.n_stats
                     )
+                    registry.counter("manager-rejected-frames").set_total(
+                        sub.n_rejected + self.n_stat_rejected
+                    )
                     registry.gauge("manager-queue-depth").set(len(self.queue))
+                    if chaos is not None:
+                        registry.counter(
+                            "chaos-corrupted-frames"
+                        ).set_total(chaos.n_corrupted)
+                        registry.counter(
+                            "chaos-dropped-frames"
+                        ).set_total(chaos.n_dropped)
+                        registry.counter(
+                            "chaos-delayed-frames"
+                        ).set_total(chaos.n_delayed)
                     if emitter.maybe_emit() and self._tracer is not None:
                         # Trace dumps ride the telemetry cadence so a recent
                         # ring is always on disk for the merger.

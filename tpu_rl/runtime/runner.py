@@ -37,10 +37,17 @@ from tpu_rl.data.layout import BatchLayout
 from tpu_rl.data.shm_ring import alloc_handles
 from tpu_rl.runtime.mailbox import STAT_SLOTS
 
+# Supervision defaults. Deployments override these via Config
+# (heartbeat_timeout_s / startup_grace_s / supervise_poll_s / max_restarts /
+# restart_*) — see Supervisor.from_config; the constants remain the
+# dataclass defaults so direct Supervisor() construction keeps working.
 HEARTBEAT_TIMEOUT = 60.0  # seconds of silence before a child is declared dead
 STARTUP_GRACE = 180.0  # extra silence allowed after (re)start: jax import +
 # XLA compile legitimately take minutes before the first loop heartbeat
 SUPERVISE_POLL = 2.0
+RESTART_WINDOW = 300.0  # sliding window for the restart budget
+RESTART_BACKOFF = 1.0  # base respawn delay within a crash streak
+RESTART_BACKOFF_MAX = 30.0
 
 
 @contextlib.contextmanager
@@ -68,6 +75,11 @@ class Child:
     cpu_only: bool
     restarts: int = 0
     started_at: float = 0.0
+    # Sliding-window restart budget + backoff state (Supervisor.check):
+    restart_times: list = field(default_factory=list)  # respawn timestamps
+    streak: int = 0  # consecutive crashes without an intervening healthy window
+    respawn_at: float = 0.0  # dead, respawn scheduled at this time (0 = none)
+    exhausted: bool = False  # budget blown; fleet shuts down
 
 
 @dataclass
@@ -83,9 +95,48 @@ class Supervisor:
     max_restarts: int = 3
     log_root: str = "logs"
     children: list[Child] = field(default_factory=list)
+    # Restart budget is per sliding window, not per process lifetime: a
+    # child may restart at most `max_restarts` times per trailing
+    # `restart_window_s` seconds. Within a crash streak, respawn N is
+    # delayed `backoff_s * 2**(N-2)` (first respawn immediate), capped at
+    # `backoff_max_s`; a child that stays up a full window resets its
+    # streak. This replaces the old lifetime counter + instant respawn,
+    # which hot-looped a crashing child straight through its budget.
+    restart_window_s: float = RESTART_WINDOW
+    backoff_s: float = RESTART_BACKOFF
+    backoff_max_s: float = RESTART_BACKOFF_MAX
+    poll_s: float = SUPERVISE_POLL
+    # Injectable for tests (backoff timing with a mocked clock).
+    clock: Callable[[], float] = time.time
+    # Optional tpu_rl.chaos.ProcessChaos, polled from loop() — the
+    # supervisor is the only place that knows every child's name and pid.
+    chaos: Any = None
 
     def __post_init__(self):
         self.stop_event = self.ctx.Event()
+        self._telem_cfg = None  # (cfg, ip, port) set by enable_telemetry
+        self._telem = None  # lazily: (registry, pub, emitter)
+
+    @classmethod
+    def from_config(cls, cfg, **kw) -> "Supervisor":
+        """Build a supervisor from Config's supervision fields; chaos
+        process faults come from ``cfg.chaos_spec`` when set."""
+        chaos = kw.pop("chaos", None)
+        if chaos is None and getattr(cfg, "chaos_spec", None):
+            from tpu_rl.chaos import ProcessChaos
+
+            chaos = ProcessChaos.from_spec(cfg.chaos_spec)
+        return cls(
+            heartbeat_timeout=cfg.heartbeat_timeout_s,
+            startup_grace=cfg.startup_grace_s,
+            max_restarts=cfg.max_restarts,
+            restart_window_s=cfg.restart_window_s,
+            backoff_s=cfg.restart_backoff_s,
+            backoff_max_s=cfg.restart_backoff_max_s,
+            poll_s=cfg.supervise_poll_s,
+            chaos=chaos,
+            **kw,
+        )
 
     # ----------------------------------------------------------------- spawn
     def spawn(
@@ -124,16 +175,37 @@ class Supervisor:
             child.proc = self.ctx.Process(
                 target=target, args=child.args, name=child.name, daemon=True
             )
-            child.heartbeat.value = time.time()
-            child.started_at = time.time()
+            child.heartbeat.value = self.clock()
+            child.started_at = self.clock()
             child.proc.start()
 
     # ------------------------------------------------------------- supervise
+    def _ensure_dead(self, child: Child) -> None:
+        """Terminate, escalating to SIGKILL: SIGTERM stays *pending* on a
+        SIGSTOP'd process, so a hung-but-stopped child survives terminate()
+        and would wedge its bound ports forever without the escalation."""
+        if child.proc.is_alive():
+            child.proc.terminate()
+            child.proc.join(5)
+        if child.proc.is_alive():
+            child.proc.kill()
+            child.proc.join(5)
+
     def check(self) -> list[str]:
-        """One supervision pass; returns names of children restarted."""
+        """One supervision pass; returns names of children respawned."""
         restarted = []
-        now = time.time()
+        now = self.clock()
         for child in self.children:
+            if child.exhausted:
+                continue
+            if child.respawn_at:
+                # Dead, waiting out its backoff delay.
+                if now >= child.respawn_at:
+                    child.respawn_at = 0.0
+                    child.restarts += 1
+                    self._start(child)
+                    restarted.append(child.name)
+                continue
             dead = not child.proc.is_alive()
             if dead and child.proc.exitcode == 0:
                 continue  # clean exit (e.g. learner hit max_updates): done
@@ -146,46 +218,123 @@ class Supervisor:
             )
             if not (dead or silent):
                 continue
-            if child.restarts >= self.max_restarts:
-                # Budget exhausted: make sure a hung-but-alive child actually
-                # dies so loop()'s exhausted-budget exit can fire.
-                if child.proc.is_alive():
-                    child.proc.terminate()
-                    child.proc.join(5)
+            self._ensure_dead(child)
+            if now - child.started_at >= self.restart_window_s:
+                child.streak = 0  # it ran healthy for a full window
+            child.streak += 1
+            child.restart_times = [
+                t for t in child.restart_times
+                if now - t < self.restart_window_s
+            ]
+            if len(child.restart_times) >= self.max_restarts:
+                child.exhausted = True
+                print(
+                    f"[supervisor] {child.name}: {len(child.restart_times)} "
+                    f"restarts within {self.restart_window_s:.0f}s — budget "
+                    "exhausted"
+                )
                 continue
-            if child.proc.is_alive():
-                child.proc.terminate()
-                child.proc.join(5)
+            child.restart_times.append(now)
+            # First crash in a streak respawns immediately (a one-off kill
+            # should not cost latency); repeats back off exponentially.
+            delay = (
+                0.0
+                if child.streak <= 1
+                else min(
+                    self.backoff_s * 2.0 ** (child.streak - 2),
+                    self.backoff_max_s,
+                )
+            )
+            if delay > 0:
+                child.respawn_at = now + delay
+                print(
+                    f"[supervisor] {child.name}: crash streak "
+                    f"{child.streak}, respawn in {delay:.1f}s"
+                )
+                continue
             child.restarts += 1
             self._start(child)
             restarted.append(child.name)
         return restarted
 
-    def loop(self, poll: float = SUPERVISE_POLL) -> None:
+    def loop(self, poll: float | None = None) -> None:
         """Block until stop: supervise children, exit when all are gone or
         any child exhausted its restart budget."""
+        poll = self.poll_s if poll is None else poll
         while not self.stop_event.is_set():
+            if self.chaos is not None:
+                for action, name in self.chaos.poll(self.children):
+                    print(f"[chaos] {action} -> {name}")
             restarted = self.check()
             for name in restarted:
                 print(f"[supervisor] restarted silent/dead child: {name}")
+            self._emit_telemetry()
             if any(
                 not c.proc.is_alive() and c.proc.exitcode == 0
+                and not c.respawn_at
                 for c in self.children
             ):
                 # A role completed its bounded work (learner max_updates):
                 # wind the whole deployment down.
                 self.stop_event.set()
                 break
-            if any(
-                not c.proc.is_alive() and c.restarts >= self.max_restarts
-                for c in self.children
-            ):
+            if any(c.exhausted for c in self.children):
                 print("[supervisor] child exhausted restart budget; stopping")
                 self.stop_event.set()
                 break
-            if all(not c.proc.is_alive() for c in self.children):
+            if all(
+                not c.proc.is_alive() and not c.respawn_at
+                for c in self.children
+            ):
                 break
             time.sleep(poll)
+        self._emit_telemetry(force=True)
+
+    # ------------------------------------------------------------ telemetry
+    def enable_telemetry(self, cfg, stat_ip: str, stat_port: int) -> None:
+        """Arm supervisor telemetry (restart/chaos counters shipped onto the
+        fleet's stat channel). Idempotent: the first caller wins, so
+        local_cluster's three role builders don't triple-publish."""
+        if self._telem_cfg is None and cfg.telemetry_enabled:
+            self._telem_cfg = (cfg, stat_ip, stat_port)
+
+    def _emit_telemetry(self, force: bool = False) -> None:
+        if self._telem_cfg is None:
+            return
+        if self._telem is None:
+            # Lazy build on the first loop() pass: keeps construction off
+            # Supervisor.__init__ (tests build bare supervisors) and off
+            # import time.
+            from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+            from tpu_rl.runtime.protocol import Protocol
+            from tpu_rl.runtime.transport import Pub
+
+            cfg, ip, port = self._telem_cfg
+            reg = MetricsRegistry(role="supervisor")
+            pub = Pub(ip, port, bind=False)
+            emitter = PeriodicSnapshot(
+                reg,
+                lambda snap: pub.send(Protocol.Telemetry, snap),
+                interval_s=cfg.telemetry_interval_s,
+            )
+            self._telem = (reg, pub, emitter)
+        reg, pub, emitter = self._telem
+        reg.counter("supervisor-restarts").set_total(
+            sum(c.restarts for c in self.children)
+        )
+        reg.counter("supervisor-exhausted").set_total(
+            sum(1 for c in self.children if c.exhausted)
+        )
+        reg.gauge("supervisor-children-alive").set(
+            sum(1 for c in self.children if c.proc.is_alive())
+        )
+        if self.chaos is not None:
+            reg.counter("chaos-process-kills").set_total(self.chaos.n_kills)
+            reg.counter("chaos-process-stops").set_total(self.chaos.n_stops)
+        if force:
+            emitter.maybe_emit(now=float("inf"))
+        else:
+            emitter.maybe_emit()
 
     # ---------------------------------------------------------------- stop
     def stop(self, timeout: float = 10.0) -> None:
@@ -200,6 +349,9 @@ class Supervisor:
             c.proc.join(2)
             if c.proc.is_alive():
                 c.proc.kill()
+        if self._telem is not None:
+            self._telem[1].close()
+            self._telem = None
 
     def install_signal_handlers(self) -> None:
         """SIGINT/SIGTERM -> cooperative stop (reference ``main.py:493-502``)."""
@@ -225,7 +377,10 @@ def learner_role(
     from tpu_rl.runtime.learner_service import learner_main
     from tpu_rl.runtime.storage import storage_main
 
-    sup = supervisor or Supervisor()
+    sup = supervisor or Supervisor.from_config(cfg)
+    # Supervisor restart/chaos counters ride the stat channel the storage
+    # child SUB-binds on this host (same path as the learner's snapshots).
+    sup.enable_telemetry(cfg, "127.0.0.1", machines.learner_port)
     layout = BatchLayout.from_config(cfg)
     from tpu_rl.config import is_off_policy
 
@@ -274,7 +429,8 @@ def worker_role(
     ``main.py:244-299``)."""
     from tpu_rl.runtime.worker import worker_main
 
-    sup = supervisor or Supervisor()
+    sup = supervisor or Supervisor.from_config(cfg)
+    sup.enable_telemetry(cfg, machines.learner_ip, machines.learner_port)
     m = machines.workers[machine_idx]
     # Warm-start every worker from the newest checkpoint when one exists
     # (reference ``main.py:247-252``: the newest saved model is loaded into
@@ -317,7 +473,8 @@ def manager_role(
     """Spawn the relay (reference ``manager_sub_process``, ``main.py:228-242``)."""
     from tpu_rl.runtime.manager import manager_main
 
-    sup = supervisor or Supervisor()
+    sup = supervisor or Supervisor.from_config(cfg)
+    sup.enable_telemetry(cfg, machines.learner_ip, machines.learner_port)
     m = machines.workers[machine_idx]
     sup.spawn(
         f"manager-{machine_idx}",
@@ -341,7 +498,7 @@ def local_cluster(
     single supervisor. The smallest real deployment and the integration-test
     harness."""
     machines = machines or MachinesConfig()
-    sup = Supervisor()
+    sup = Supervisor.from_config(cfg)
     learner_role(
         cfg,
         machines,

@@ -82,13 +82,24 @@ class LearnerStorage:
         self._tracer = None
         self._trace_path = None
         self.clocksync = None
+        # Fault injection (tpu_rl.chaos): corrupt/drop:rollout|stat|telemetry
+        # and delay:storage apply at THIS Sub's receives — the consuming edge
+        # — so every injected corruption pairs with one n_rejected in the
+        # same recv call. None unless a chaos_spec names this site.
+        self._chaos = None
+        if cfg.chaos_spec:
+            from tpu_rl.chaos import maybe_transport_chaos
+
+            self._chaos = maybe_transport_chaos(cfg, "storage")
 
     def run(self) -> None:
         cfg = self.cfg
         layout = BatchLayout.from_config(cfg)
         assembler = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
         store = make_store(cfg, layout, handles=self.handles)
-        sub = self._sub = Sub("*", self.learner_port, bind=True)
+        sub = self._sub = Sub(
+            "*", self.learner_port, bind=True, chaos=self._chaos
+        )
         self._setup_trace(assembler)
         self._setup_telemetry()
         try:
@@ -218,6 +229,16 @@ class LearnerStorage:
             self.aggregator.n_ingested
         )
         reg.gauge("storage-game-count").set(self.game_count)
+        if self._chaos is not None:
+            reg.counter("chaos-corrupted-frames").set_total(
+                self._chaos.n_corrupted
+            )
+            reg.counter("chaos-dropped-frames").set_total(
+                self._chaos.n_dropped
+            )
+            reg.counter("chaos-delayed-frames").set_total(
+                self._chaos.n_delayed
+            )
         if self._json_exp is not None and self._json_exp.maybe_export():
             if self._tb_exp is not None:
                 self._tb_exp.export(self.aggregator)

@@ -47,10 +47,16 @@ class Pub:
     """Synchronous PUB endpoint (the learner's model broadcast is sync in the
     reference too, ``agents/learner.py:85-90``)."""
 
-    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM,
+                 ctx=None, chaos=None):
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.PUB)
         self.sock.set_hwm(hwm)
+        # Optional fault injector (tpu_rl.chaos.TransportChaos). None — the
+        # default and the production state — keeps the send path on the
+        # exact pre-chaos code: one `is None` check, no allocations (pinned
+        # by tests/test_chaos.py tracemalloc).
+        self._chaos = chaos
         ep = _endpoint(ip, port)
         self.sock.bind(ep) if bind else self.sock.connect(ep)
 
@@ -60,12 +66,21 @@ class Pub:
         """``trace`` (a ``protocol.pack_trace`` trailer) rides as the
         optional third wire part on sampled rollout frames; None (the
         default and the sampling-off state) keeps the exact 2-part frame."""
-        self.sock.send_multipart(encode(proto, payload, trace))
+        parts = encode(proto, payload, trace)
+        if self._chaos is not None:
+            parts = self._chaos.on_send(parts)
+            if parts is None:
+                return
+        self.sock.send_multipart(parts)
 
     def send_raw(self, parts: list[bytes]) -> None:
         """Forward already-encoded wire parts verbatim — the zero-copy relay
         hop (no pack/compress/CRC; zmq ships the same buffers it received).
         A trace trailer, being just a third part, is forwarded for free."""
+        if self._chaos is not None:
+            parts = self._chaos.on_send(parts)
+            if parts is None:
+                return
         self.sock.send_multipart(parts)
 
     def close(self) -> None:
@@ -79,12 +94,18 @@ class Sub:
     counted, never raised — one stray publisher on a best-effort PUB/SUB
     fabric must not crash a role process."""
 
-    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM,
+                 ctx=None, chaos=None):
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.SUB)
         self.sock.set_hwm(hwm)
         self.sock.setsockopt(zmq.SUBSCRIBE, b"")
         self.n_rejected = 0
+        # Optional fault injector applied to received parts BEFORE decode:
+        # an injected corruption therefore pairs with its n_rejected bump in
+        # the same call, which is what makes chaos accounting exact. None
+        # (default) costs one `is None` check per frame.
+        self._chaos = chaos
         ep = _endpoint(ip, port)
         self.sock.bind(ep) if bind else self.sock.connect(ep)
 
@@ -94,8 +115,13 @@ class Sub:
         if timeout_ms is not None:
             if not self.sock.poll(timeout_ms):
                 return None
+        parts = self.sock.recv_multipart()
+        if self._chaos is not None:
+            parts = self._chaos.on_recv(parts)
+            if parts is None:
+                return None
         try:
-            return decode(self.sock.recv_multipart())
+            return decode(parts)
         except ValueError:
             self.n_rejected += 1
             return None
@@ -107,6 +133,10 @@ class Sub:
                 parts = self.sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
                 return
+            if self._chaos is not None:
+                parts = self._chaos.on_recv(parts)
+                if parts is None:
+                    continue
             try:
                 yield decode(parts)
             except ValueError:
@@ -123,6 +153,10 @@ class Sub:
             if not self.sock.poll(timeout_ms):
                 return None
         parts = self.sock.recv_multipart()
+        if self._chaos is not None:
+            parts = self._chaos.on_recv(parts)
+            if parts is None:
+                return None
         try:
             proto, payload = decode(parts)
         except ValueError:
@@ -140,6 +174,10 @@ class Sub:
                 parts = self.sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
                 return
+            if self._chaos is not None:
+                parts = self._chaos.on_recv(parts)
+                if parts is None:
+                    continue
             try:
                 proto, payload = decode(parts)
             except ValueError:
@@ -158,6 +196,10 @@ class Sub:
             if not self.sock.poll(timeout_ms):
                 return None
         parts = self.sock.recv_multipart()
+        if self._chaos is not None:
+            parts = self._chaos.on_recv(parts)
+            if parts is None:
+                return None
         try:
             return peek(parts), parts
         except ValueError:
@@ -174,6 +216,10 @@ class Sub:
                 parts = self.sock.recv_multipart(zmq.NOBLOCK)
             except zmq.Again:
                 return
+            if self._chaos is not None:
+                parts = self._chaos.on_recv(parts)
+                if parts is None:
+                    continue
             try:
                 yield peek(parts), parts
             except ValueError:

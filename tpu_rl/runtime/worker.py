@@ -28,9 +28,12 @@ forces ``JAX_PLATFORMS=cpu`` into worker/manager/storage children.
   RolloutBatch is **bit-identical in layout** to local mode — manager,
   storage, assembler and algorithms cannot tell the modes apart. If the
   service times out ``inference_retries`` times the worker logs once and
-  permanently falls back to local acting on its last-known broadcast params
-  (the model SUB is drained in both modes precisely so this fallback never
-  acts on init-fresh weights).
+  falls back to local acting on its last-known broadcast params (the model
+  SUB is drained in both modes precisely so this fallback never acts on
+  init-fresh weights), then re-probes the service every
+  ``inference_reprobe_s`` seconds (exponential backoff) so a restarted
+  service regains its clients; ``inference_reprobe_s=0`` restores the old
+  permanent fallback.
 """
 
 from __future__ import annotations
@@ -71,8 +74,13 @@ class Worker:
         self.initial_params = initial_params
         self.seed = seed
         self.inference_port = inference_port
-        self.fell_back = False  # remote acting permanently abandoned
+        self.fell_back = False  # currently acting locally after a timeout
         self.n_remote_acts = 0
+        # Recovery-event counters (telemetry + flight recorder): fallbacks
+        # to local acting, re-probe attempts, successful restorations.
+        self.n_fallbacks = 0
+        self.n_reprobes = 0
+        self.n_restores = 0
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -83,8 +91,20 @@ class Worker:
 
         cfg = self.cfg
         manager_ip, manager_port, learner_ip, model_port = self.addr
-        pub = Pub(manager_ip, manager_port, bind=False)
-        model_sub = Sub(learner_ip, model_port, bind=False, hwm=MODEL_HWM)
+        # Fault injection (tpu_rl.chaos): delay:worker shims this worker's
+        # sends, corrupt/drop:model its model-SUB receives. None unless a
+        # chaos_spec names this site.
+        chaos = None
+        if cfg.chaos_spec:
+            from tpu_rl.chaos import maybe_transport_chaos
+
+            chaos = maybe_transport_chaos(
+                cfg, "worker", instance=self.worker_id
+            )
+        pub = Pub(manager_ip, manager_port, bind=False, chaos=chaos)
+        model_sub = Sub(
+            learner_ip, model_port, bind=False, hwm=MODEL_HWM, chaos=chaos
+        )
 
         # Telemetry (tpu_rl.obs): periodic registry snapshots ride the same
         # PUB as rollouts/stats, emitted on the CLOCK — an idle or wedged
@@ -134,7 +154,16 @@ class Worker:
                 cfg.result_dir, f"trace-worker-{os.getpid()}.json"
             )
             flightrec.install(
-                "worker", cfg.result_dir, tracer=tracer, cfg=cfg
+                "worker",
+                cfg.result_dir,
+                tracer=tracer,
+                cfg=cfg,
+                extra=lambda: {
+                    "fell_back": self.fell_back,
+                    "n_fallbacks": self.n_fallbacks,
+                    "n_reprobes": self.n_reprobes,
+                    "n_restores": self.n_restores,
+                },
             )
 
         family = build_family(cfg)
@@ -156,11 +185,19 @@ class Worker:
             remote = InferenceClient(
                 cfg, learner_ip, self.inference_port, wid=self.worker_id
             )
-        # Corrupt-reply count on the inference DEALER, captured before the
-        # fallback closes the client so the total survives into later stat
-        # publishes (satellite of ISSUE 3: remote-acting drops were invisible
+        # Corrupt-reply count accumulated from CLOSED inference clients
+        # (each fallback/failed probe folds its client's n_rejected in
+        # before closing); the live client's count is added at read sites,
+        # so the published total survives any number of fallback/restore
+        # cycles (satellite of ISSUE 3: remote-acting drops were invisible
         # — only the model-SUB count reached the dashboards).
         remote_rejected = 0
+        # Fallback recovery state: when remote acting drops to local, probe
+        # the service again every `inference_reprobe_s`, doubling up to
+        # `inference_reprobe_max_s` while it stays down. 0 disables (the
+        # old permanent one-way degradation).
+        next_reprobe: float | None = None
+        reprobe_backoff = cfg.inference_reprobe_s
 
         # Vectorized acting: N envs stepped per tick with ONE batched policy
         # forward (worker_num_envs; N=1 reproduces the reference's
@@ -226,22 +263,72 @@ class Worker:
                 reply = remote.act(obs, is_fir) if remote is not None else None
                 if remote is not None and reply is None:
                     # Fault path: the service timed out through every retry.
-                    # Log ONCE, drop to local acting on the last broadcast
-                    # params for the rest of this worker's life — a dead
-                    # server must never wedge the fleet.
+                    # Log once per fallback, drop to local acting on the
+                    # last broadcast params — a dead server must never
+                    # wedge the fleet — and schedule a re-probe so a
+                    # RESTARTED server regains this client.
                     print(
                         f"[worker {self.worker_id}] inference service "
                         f"unreachable after "
                         f"{cfg.inference_retries + 1} attempts of "
                         f"{cfg.inference_timeout_ms} ms; falling back to "
-                        f"local acting",
+                        f"local acting"
+                        + (
+                            f" (re-probing every {reprobe_backoff:.0f}s)"
+                            if cfg.inference_reprobe_s > 0
+                            else " permanently"
+                        ),
                         file=sys.stderr,
                         flush=True,
                     )
-                    remote_rejected = remote.n_rejected
+                    remote_rejected += remote.n_rejected
                     remote.close()
                     remote = None
                     self.fell_back = True
+                    self.n_fallbacks += 1
+                    if cfg.inference_reprobe_s > 0:
+                        next_reprobe = time.monotonic() + reprobe_backoff
+                elif (
+                    remote is None
+                    and next_reprobe is not None
+                    and time.monotonic() >= next_reprobe
+                ):
+                    # Re-probe: one zero-retry request on a FRESH client
+                    # (fresh DEALER identity — the old one may be black-
+                    # holed in a dead server's queue). Success restores
+                    # remote acting and this tick already has its reply;
+                    # failure costs one inference_timeout_ms and doubles
+                    # the probe interval.
+                    from tpu_rl.runtime.inference_service import (
+                        InferenceClient,
+                    )
+
+                    probe = InferenceClient(
+                        cfg, learner_ip, self.inference_port,
+                        wid=self.worker_id,
+                    )
+                    self.n_reprobes += 1
+                    reply = probe.act(obs, is_fir, retries=0)
+                    if reply is not None:
+                        remote = probe
+                        self.fell_back = False
+                        self.n_restores += 1
+                        reprobe_backoff = cfg.inference_reprobe_s
+                        next_reprobe = None
+                        print(
+                            f"[worker {self.worker_id}] inference service "
+                            "reachable again; remote acting restored",
+                            file=sys.stderr,
+                            flush=True,
+                        )
+                    else:
+                        remote_rejected += probe.n_rejected
+                        probe.close()
+                        reprobe_backoff = min(
+                            reprobe_backoff * 2.0,
+                            cfg.inference_reprobe_max_s,
+                        )
+                        next_reprobe = time.monotonic() + reprobe_backoff
                 if reply is not None:
                     # The service already sampled on the learner's device;
                     # for store_carry families the reply carries the
@@ -297,15 +384,14 @@ class Worker:
                         # reference's bare-float form. n_rejected covers both
                         # of this worker's receive channels: the model SUB
                         # and (when acting remotely) the inference DEALER.
-                        if remote is not None:
-                            remote_rejected = remote.n_rejected
                         pub.send(
                             Protocol.Stat,
                             {
                                 "rew": float(epi_rew[i]),
                                 "n_model_loads": n_model_loads,
                                 "n_rejected": model_sub.n_rejected
-                                + remote_rejected,
+                                + remote_rejected
+                                + (remote.n_rejected if remote else 0),
                                 "wid": self.worker_id,
                             },
                         )
@@ -381,8 +467,29 @@ class Worker:
                     )
                     registry.counter("worker-rejected-frames").set_total(
                         model_sub.n_rejected
-                        + (remote.n_rejected if remote else remote_rejected)
+                        + remote_rejected
+                        + (remote.n_rejected if remote else 0)
                     )
+                    if cfg.act_mode == "remote":
+                        registry.counter(
+                            "worker-remote-fallbacks"
+                        ).set_total(self.n_fallbacks)
+                        registry.counter(
+                            "worker-remote-reprobes"
+                        ).set_total(self.n_reprobes)
+                        registry.counter(
+                            "worker-remote-restores"
+                        ).set_total(self.n_restores)
+                    if chaos is not None:
+                        registry.counter(
+                            "chaos-corrupted-frames"
+                        ).set_total(chaos.n_corrupted)
+                        registry.counter(
+                            "chaos-dropped-frames"
+                        ).set_total(chaos.n_dropped)
+                        registry.counter(
+                            "chaos-delayed-frames"
+                        ).set_total(chaos.n_delayed)
                     if emitter.maybe_emit() and tracer is not None:
                         # Trace dumps ride the telemetry cadence: no clock
                         # of their own, and a crash between dumps still
