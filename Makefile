@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: lint format-check test relay-smoke obs-smoke trace-smoke chaos-smoke ci
+.PHONY: lint format-check test relay-smoke obs-smoke trace-smoke chaos-smoke \
+	colocated-smoke ci
 
 lint:
 	ruff check .
@@ -41,4 +42,11 @@ trace-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/chaos_smoke.py
 
-ci: lint test relay-smoke obs-smoke trace-smoke chaos-smoke
+# Colocated (Anakin) smoke: a short fused on-device CartPole run must learn
+# (best-window mean return over the bar) and the colocated-vs-distributed
+# bench row must emit with direction-consistent numbers. Full capture:
+# TPU_RL_BENCH_COLOCATED=1 python bench.py  (writes bench_colocated[.cpu].json).
+colocated-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/colocated_smoke.py
+
+ci: lint test relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke

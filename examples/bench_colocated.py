@@ -1,0 +1,60 @@
+"""Colocated (Anakin) A/B: fused on-device loop vs distributed feed.
+
+The harness lives in ``bench.run_colocated_compare`` (shared with the
+``TPU_RL_BENCH_COLOCATED=1 python bench.py`` mode); this wrapper adds the
+CLI. Both sides run the reference learner workload (IMPALA, batch x seq 5,
+hidden 64, obs 4 / act 2):
+
+- distributed: ``bench.e2e_learner_row`` — feeder threads memcpy windows
+  into the real shm OnPolicyStore while the production LearnerService
+  consumes and train-steps them (prefetched feed, the data plane's best
+  configuration). This is the storage->learner transitions/s the
+  acceptance bar compares against.
+- colocated: ``runtime/colocated.py``'s fused program — ``family.act`` ->
+  jittable CartPole step -> window assembly -> ``train_step`` as ONE jitted
+  dispatch, envs resident on device. Measured at the same 128-env quantum
+  (headline speedup) plus larger env batches (scale rows).
+
+Run on CPU (acceptance: speedup >= 2x) or on an accelerator:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/bench_colocated.py \
+      [--updates 200] [--env-batches 128,1024] [--out bench_colocated.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--updates", type=int, default=None,
+                   help="timed fused iterations per env-batch row "
+                        "(default 200 on CPU, 2048 on chip)")
+    p.add_argument("--env-batches", default=None,
+                   help="comma-separated env-batch sizes, e.g. 128,1024 "
+                        "(default 128,1024 on CPU; 128,1024,4096 on chip)")
+    p.add_argument("--out", default=None,
+                   help="result JSON path (default bench_colocated[.cpu].json)")
+    args = p.parse_args()
+
+    from bench import run_colocated_compare
+
+    env_batches = (
+        tuple(int(s) for s in args.env_batches.split(","))
+        if args.env_batches else None
+    )
+    result = run_colocated_compare(
+        updates=args.updates,
+        env_batches=env_batches,
+        out_path=args.out,
+    )
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,38 @@ def test_last_good_onchip_falls_back_to_git_commit_time(tmp_path):
         assert real["recorded_at"] and real["recorded_at"][:3] == "202"
 
 
+def test_run_all_cpu_headline_carries_stale_onchip(tmp_path, monkeypatch):
+    """A CPU-backend run_all (the direct path, not just the outage fallback)
+    must flag its numbers in the summary line itself: device_kind, the
+    stale on-chip embed, and a note citing the last chip headline — so a
+    CPU-fallback capture can never be silently read as on-chip (ISSUE 7
+    satellite)."""
+    monkeypatch.setattr(
+        bench, "bench_one",
+        lambda name, *a, **kw: {"name": name, "tps": 1234.0,
+                                "step_ms": 1.0, "mfu": None,
+                                "steps_per_call": 1},
+    )
+    stale = {"recorded_at": "2026-07-31T16:21:00Z",
+             "device_kind": "TPU v5 lite", "headline_tps": 5_320_000.0,
+             "vs_baseline": 8866.67, "rows": []}
+    monkeypatch.setattr(bench, "last_good_onchip", lambda path=None: stale)
+    out = bench.run_all(out_path=str(tmp_path / "m.json"))
+    assert out["value"] == 1234.0
+    assert out["device_kind"].lower().startswith("cpu")
+    assert out["stale_onchip"] is True
+    assert out["last_onchip"] == stale
+    assert "5320000.0 tps on TPU v5 lite" in out["note"]
+    assert "stale" in out["note"]
+
+    # No committed on-chip record at all: the note still flags CPU, and the
+    # stale fields are simply absent (never fabricated).
+    monkeypatch.setattr(bench, "last_good_onchip", lambda path=None: None)
+    out = bench.run_all(out_path=str(tmp_path / "m2.json"))
+    assert "stale_onchip" not in out and "last_onchip" not in out
+    assert "CPU backend" in out["note"]
+
+
 def test_committed_matrix_headline_matches_run_tpu_record():
     """The committed bench_results.json must parse and carry the on-chip
     IMPALA@ref headline the round-4 record cites."""

@@ -1,0 +1,162 @@
+"""Jittable env dynamics pinned against gymnasium's reference physics.
+
+The colocated driver (runtime/colocated.py) trains on ``tpu_rl/envs``'
+transcriptions of CartPole-v1 and Pendulum-v1; these tests pin them to the
+real gymnasium implementations for a fixed action sequence from an identical
+start state. gymnasium integrates in float64 and we run float32, so
+trajectories are tolerance-bounded rather than bit-exact; termination flags
+and reward structure must agree exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_rl.envs import get_spec, make_vec_env
+from tpu_rl.envs.cartpole import THETA_THRESHOLD, X_THRESHOLD
+
+
+def _key(i: int = 0):
+    return jax.random.PRNGKey(i)
+
+
+# ------------------------------------------------------------------ registry
+def test_get_spec_known_envs():
+    cp = get_spec("CartPole-v1")
+    assert cp.obs_shape == (4,) and cp.action_space == 2
+    assert not cp.is_continuous and cp.gym_horizon == 500
+    pd = get_spec("Pendulum-v1")
+    assert pd.obs_shape == (3,) and pd.action_space == 1
+    assert pd.is_continuous and pd.gym_horizon == 200
+
+
+def test_get_spec_unknown_env_lists_known():
+    with pytest.raises(ValueError, match="CartPole-v1"):
+        get_spec("Breakout-v4")
+
+
+# ---------------------------------------------------- gymnasium physics pins
+def test_cartpole_matches_gymnasium():
+    gym = pytest.importorskip("gymnasium")
+    spec = get_spec("CartPole-v1")
+    env = gym.make("CartPole-v1").unwrapped
+    obs, _ = env.reset(seed=3)
+    state = jnp.asarray(obs, jnp.float32)
+    step = jax.jit(spec.step)
+    rng = np.random.default_rng(0)
+    for t in range(60):
+        a = int(rng.integers(0, 2))
+        state, ours_obs, rew, done = step(
+            state, jnp.float32([a]), _key(t)
+        )
+        ref_obs, ref_rew, term, trunc, _ = env.step(a)
+        np.testing.assert_allclose(
+            np.asarray(ours_obs), ref_obs, atol=2e-4,
+            err_msg=f"diverged from gymnasium at step {t}",
+        )
+        assert bool(done) == bool(term), f"termination mismatch at step {t}"
+        assert float(rew) == ref_rew == 1.0  # reward 1.0 incl. terminal step
+        if term:
+            break
+    else:
+        pytest.fail("action sequence never terminated; pin is vacuous")
+
+
+def test_pendulum_matches_gymnasium():
+    gym = pytest.importorskip("gymnasium")
+    spec = get_spec("Pendulum-v1")
+    env = gym.make("Pendulum-v1").unwrapped
+    env.reset(seed=5)
+    state = jnp.asarray(env.state, jnp.float32)
+    step = jax.jit(spec.step)
+    rng = np.random.default_rng(1)
+    for t in range(60):
+        u = float(rng.uniform(-2.0, 2.0))
+        state, ours_obs, rew, done = step(
+            state, jnp.float32([u]), _key(t)
+        )
+        ref_obs, ref_rew, term, trunc, _ = env.step(np.float32([u]))
+        np.testing.assert_allclose(
+            np.asarray(ours_obs), ref_obs, atol=2e-4,
+            err_msg=f"diverged from gymnasium at step {t}",
+        )
+        np.testing.assert_allclose(float(rew), ref_rew, atol=2e-4)
+        assert not bool(done) and not term  # Pendulum never terminates
+
+
+def test_cartpole_terminates_within_bounds():
+    """Constant pushes must tip the pole: done fires exactly when the state
+    exits the (|x|, |theta|) box, and never before."""
+    spec = get_spec("CartPole-v1")
+    state, _ = spec.reset(_key(7))
+    step = jax.jit(spec.step)
+    for t in range(500):
+        in_bounds = (
+            abs(float(state[0])) <= X_THRESHOLD
+            and abs(float(state[2])) <= THETA_THRESHOLD
+        )
+        assert in_bounds, f"pre-step state already out of bounds at {t}"
+        state, _obs, _rew, done = step(state, jnp.float32([1.0]), _key(t))
+        out_of_bounds = (
+            abs(float(state[0])) > X_THRESHOLD
+            or abs(float(state[2])) > THETA_THRESHOLD
+        )
+        assert bool(done) == out_of_bounds
+        if done:
+            return
+    pytest.fail("constant-push CartPole never terminated")
+
+
+# ------------------------------------------------------- vec wrapper behavior
+def test_vec_env_autoreset_on_termination():
+    """Done slots come back already reset: fresh CartPole physics in the
+    reset range, step counter zeroed, live envs untouched."""
+    spec = get_spec("CartPole-v1")
+    v_reset, v_step = make_vec_env(spec, n_envs=8, horizon=500)
+    state, obs = v_reset(_key(0))
+    step = jax.jit(v_step)
+    saw_done = False
+    for t in range(400):
+        actions = jnp.ones((8, 1), jnp.float32)  # constant push tips poles
+        prev_t = state["t"]
+        state, obs, rew, done = step(state, actions, _key(100 + t))
+        d = np.asarray(done)
+        o = np.asarray(obs)
+        tt = np.asarray(state["t"])
+        if d.any():
+            saw_done = True
+            # reset obs are uniform in [-0.05, 0.05]^4 and t restarts
+            assert np.all(np.abs(o[d]) <= 0.05)
+            assert np.all(tt[d] == 0)
+        # live envs keep counting
+        assert np.all(tt[~d] == np.asarray(prev_t)[~d] + 1)
+        assert np.all(np.asarray(rew) == 1.0)  # reward is the transition's
+    assert saw_done, "no env terminated; autoreset never exercised"
+
+
+def test_vec_env_horizon_truncation():
+    """Pendulum never terminates, so done must fire exactly every `horizon`
+    steps — the wrapper's time-limit truncation, like the worker loop's."""
+    spec = get_spec("Pendulum-v1")
+    v_reset, v_step = make_vec_env(spec, n_envs=4, horizon=10)
+    state, _obs = v_reset(_key(1))
+    step = jax.jit(v_step)
+    for t in range(1, 31):
+        state, _obs, _rew, done = step(
+            state, jnp.zeros((4, 1), jnp.float32), _key(t)
+        )
+        expected = t % 10 == 0
+        assert bool(np.all(np.asarray(done) == expected)), (
+            f"step {t}: done={np.asarray(done)}, expected all {expected}"
+        )
+
+
+def test_vec_env_reset_diversity():
+    """Per-env reset keys differ: envs must not start identical."""
+    spec = get_spec("Pendulum-v1")
+    v_reset, _ = make_vec_env(spec, n_envs=16, horizon=200)
+    state, obs = v_reset(_key(2))
+    assert np.unique(np.asarray(obs), axis=0).shape[0] == 16

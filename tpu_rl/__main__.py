@@ -6,6 +6,7 @@ plus ``local`` (whole cluster on one host — the smallest real deployment).
 
 Examples:
     python -m tpu_rl local --env CartPole-v1 --algo PPO
+    python -m tpu_rl local --env CartPole-v1 --algo PPO --env-mode colocated
     python -m tpu_rl learner --params params.json --machines machines.json
     python -m tpu_rl manager --machines machines.json --machine-idx 0
     python -m tpu_rl worker  --machines machines.json --machine-idx 0
@@ -31,6 +32,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="index into machines.workers for manager/worker roles")
     p.add_argument("--env", help="override env id")
     p.add_argument("--algo", help="override algorithm")
+    p.add_argument("--env-mode", choices=["distributed", "colocated"],
+                   default=None,
+                   help="'colocated' fuses act->env.step->train into one "
+                   "jitted on-device program (jittable envs only; see "
+                   "tpu_rl/envs)")
+    p.add_argument("--colocated-envs", type=int, default=None,
+                   help="env-batch size for colocated mode (overrides "
+                   "batch_size there; 0/unset = batch_size)")
     p.add_argument("--mesh-data", type=int, help="learner data-mesh size")
     p.add_argument("--max-updates", type=int, default=None)
     p.add_argument("--publish-interval", type=int, default=1)
@@ -70,6 +79,10 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["env"] = args.env
     if args.algo:
         overrides["algo"] = args.algo
+    if args.env_mode is not None:
+        overrides["env_mode"] = args.env_mode
+    if args.colocated_envs is not None:
+        overrides["colocated_envs"] = args.colocated_envs
     if args.mesh_data:
         overrides["mesh_data"] = args.mesh_data
     if args.telemetry_port is not None:
@@ -119,7 +132,18 @@ def main(argv: list[str] | None = None) -> int:
 
     from tpu_rl.runtime import runner
 
-    if args.role == "local":
+    if cfg.env_mode == "colocated" and args.role in ("manager", "worker"):
+        print(
+            f"colocated mode has no {args.role} role: the envs live inside "
+            "the fused on-device program (use 'local' or 'learner')",
+            file=sys.stderr,
+        )
+        return 2
+    if cfg.env_mode == "colocated" and args.role == "learner":
+        sup = runner.colocated_role(
+            cfg, machines, max_updates=args.max_updates, seed=args.seed
+        )
+    elif args.role == "local":
         sup = runner.local_cluster(
             cfg,
             machines,

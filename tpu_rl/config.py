@@ -244,6 +244,21 @@ class Config:
     # per-process throughput. Works for every backbone: the transformer
     # acting carry packs per-env KV caches with per-row step counters.
     worker_num_envs: int = 1
+    # ---- colocated (Anakin) mode (tpu_rl.runtime.colocated) ----
+    # "distributed": the reference topology — gymnasium envs on host worker
+    # processes, rollouts over ZMQ into shm, learner consumes (everything
+    # above). "colocated": Podracer-Anakin — pure-JAX vectorized envs
+    # (tpu_rl.envs) stepped INSIDE the jitted training loop on the learner
+    # mesh; no workers, no manager, no storage, no host hop. One process,
+    # one program: act -> env step -> window assembly -> train_step fused
+    # under a single jit, the env batch sharded over the data mesh.
+    env_mode: str = "distributed"
+    # Env-batch size for colocated mode; each fused iteration rolls this
+    # many envs seq_len steps and trains on the resulting windows, so it
+    # overrides batch_size there (the env batch IS the train batch).
+    # 0 = use batch_size unchanged. Thousands of instances is the intended
+    # operating point on chip; tests/CI run tens.
+    colocated_envs: int = 0
     # RolloutAssembler idle-trajectory drop window, seconds
     # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
     rollout_lag_sec: float = 0.5
@@ -395,6 +410,20 @@ class Config:
         )
         assert self.learner_device in ("auto", "cpu"), self.learner_device
         assert self.worker_num_envs >= 1, self.worker_num_envs
+        assert self.env_mode in ("distributed", "colocated"), self.env_mode
+        assert self.colocated_envs >= 0, self.colocated_envs
+        if self.env_mode == "colocated":
+            # Off-policy replay lives in host shared memory (data/shm_ring);
+            # the colocated loop is on-device and consumes each rollout once
+            # — on-policy by construction. SAC needs the distributed path.
+            assert not is_off_policy(self.algo), (
+                f"env_mode='colocated' is on-policy only (each fused rollout "
+                f"trains once, no replay); {self.algo} needs "
+                f"env_mode='distributed'"
+            )
+            assert not self.need_conv, (
+                "colocated mode has no image-env dynamics (tpu_rl.envs)"
+            )
         assert self.act_mode in ("local", "remote"), self.act_mode
         assert self.relay_mode in ("raw", "decode"), self.relay_mode
         assert self.inference_batch >= 1, self.inference_batch

@@ -1,0 +1,34 @@
+"""Pure-JAX vectorized environments for colocated (Anakin) mode.
+
+The distributed path steps gymnasium envs on host CPUs (runtime/env.py);
+this package provides jittable, gymnax-style dynamics for the same envs so
+``Config.env_mode="colocated"`` can run act -> step -> train entirely on the
+learner mesh (Podracer "Anakin", PAPERS.md) — no workers, no ZMQ, no host
+hop. Each env is an :class:`~tpu_rl.envs.core.EnvSpec`: pure
+``reset(key)`` / ``step(state, action, key)`` functions plus the space
+metadata ``probe_spaces`` derives from gymnasium today, so colocated runs
+never import gym at all.
+"""
+
+from tpu_rl.envs.cartpole import CARTPOLE
+from tpu_rl.envs.core import EnvSpec, make_vec_env
+from tpu_rl.envs.pendulum import PENDULUM
+
+# Jittable counterparts of the gymnasium ids the distributed path uses —
+# same names, so `--env CartPole-v1 --env-mode colocated` Just Works.
+SPECS: dict[str, EnvSpec] = {
+    CARTPOLE.name: CARTPOLE,
+    PENDULUM.name: PENDULUM,
+}
+
+
+def get_spec(name: str) -> EnvSpec:
+    if name not in SPECS:
+        raise ValueError(
+            f"no jittable dynamics for env {name!r}; colocated mode knows "
+            f"{sorted(SPECS)} (use env_mode='distributed' for gymnasium envs)"
+        )
+    return SPECS[name]
+
+
+__all__ = ["SPECS", "EnvSpec", "get_spec", "make_vec_env"]

@@ -1,0 +1,76 @@
+"""Jittable CartPole-v1 dynamics.
+
+Transcribes gymnasium's reference physics
+(``gymnasium/envs/classic_control/cartpole.py``): Euler integration at
+``tau=0.02`` of the Barto-Sutton-Anderson cart-pole, termination at
+``|x| > 2.4`` or ``|theta| > 12°``, reward 1.0 every step (including the
+terminating one), reset uniform in ``[-0.05, 0.05]^4``. gymnasium integrates
+in float64; this runs in float32, so trajectories track the reference to
+~1e-4 over tens of steps rather than bit-exactly
+(``tests/test_envs.py`` pins the tolerance).
+
+State is the raw ``(4,)`` f32 vector ``[x, x_dot, theta, theta_dot]``;
+the observation is the state itself, as in gymnasium.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from tpu_rl.envs.core import EnvSpec
+
+GRAVITY = 9.8
+MASSCART = 1.0
+MASSPOLE = 0.1
+TOTAL_MASS = MASSPOLE + MASSCART
+LENGTH = 0.5  # half the pole's length
+POLEMASS_LENGTH = MASSPOLE * LENGTH
+FORCE_MAG = 10.0
+TAU = 0.02
+X_THRESHOLD = 2.4
+THETA_THRESHOLD = 12 * 2 * math.pi / 360  # ~0.2095 rad
+
+
+def reset(key: jax.Array):
+    state = jax.random.uniform(
+        key, (4,), jnp.float32, minval=-0.05, maxval=0.05
+    )
+    return state, state
+
+
+def step(state: jax.Array, action: jax.Array, key: jax.Array):
+    del key  # deterministic dynamics; key kept for the EnvSpec contract
+    x, x_dot, theta, theta_dot = state
+    # action: (1,) float index from the discrete policy (0 = push left).
+    force = jnp.where(action.reshape(()) > 0.5, FORCE_MAG, -FORCE_MAG)
+    costheta = jnp.cos(theta)
+    sintheta = jnp.sin(theta)
+    temp = (
+        force + POLEMASS_LENGTH * theta_dot**2 * sintheta
+    ) / TOTAL_MASS
+    thetaacc = (GRAVITY * sintheta - costheta * temp) / (
+        LENGTH * (4.0 / 3.0 - MASSPOLE * costheta**2 / TOTAL_MASS)
+    )
+    xacc = temp - POLEMASS_LENGTH * thetaacc * costheta / TOTAL_MASS
+    # Euler, in gymnasium's update order (positions first, from OLD rates).
+    x = x + TAU * x_dot
+    x_dot = x_dot + TAU * xacc
+    theta = theta + TAU * theta_dot
+    theta_dot = theta_dot + TAU * thetaacc
+    state = jnp.stack([x, x_dot, theta, theta_dot])
+    done = (jnp.abs(x) > X_THRESHOLD) | (jnp.abs(theta) > THETA_THRESHOLD)
+    return state, state, jnp.float32(1.0), done
+
+
+CARTPOLE = EnvSpec(
+    name="CartPole-v1",
+    obs_shape=(4,),
+    action_space=2,
+    is_continuous=False,
+    gym_horizon=500,
+    reset=reset,
+    step=step,
+)

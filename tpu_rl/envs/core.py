@@ -1,0 +1,93 @@
+"""EnvSpec contract + the vmap-batched auto-reset wrapper.
+
+An :class:`EnvSpec` holds one env's pure dynamics plus the space metadata the
+rest of the stack derives from gymnasium in distributed mode:
+
+- ``reset(key) -> (state, obs)``: fresh physics state + its observation;
+- ``step(state, action, key) -> (state, obs, reward, done)``: one transition.
+  ``action`` is the policy-side float vector — a (1,) float index for
+  discrete envs, an (A,) vector for continuous — exactly what
+  ``ModelFamily.act`` emits and ``EnvAdapter.step`` consumes, so the
+  colocated driver and the distributed worker share the acting contract.
+  ``done`` is *termination only* (pole fell, bounds exceeded); truncation is
+  the wrapper's job, driven by ``Config.time_horizon`` like the worker loop.
+
+:func:`make_vec_env` lifts a spec to an n-env batch with per-env auto-reset:
+when an env terminates (or hits the horizon), its slot is reset in place with
+a fresh key and the *reset* observation is returned — the reward is still the
+real transition's. This is the on-device equivalent of the worker's
+``env.reset()`` + ``is_fir=1`` bookkeeping (runtime/worker.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+State = Any  # per-env physics state pytree (ours are flat f32 arrays)
+
+
+@dataclass(frozen=True)
+class EnvSpec:
+    """One env's pure dynamics + the spaces ``probe_spaces`` needs."""
+
+    name: str
+    obs_shape: tuple[int, ...]
+    action_space: int  # n discrete actions, or continuous action dim
+    is_continuous: bool
+    # The gymnasium TimeLimit for this env — documentation/parity aid only;
+    # the colocated driver truncates at Config.time_horizon (as the worker
+    # does), so set time_horizon to this value for exact gym-MDP parity.
+    gym_horizon: int
+    reset: Callable[[jax.Array], tuple[State, jax.Array]]
+    step: Callable[
+        [State, jax.Array, jax.Array],
+        tuple[State, jax.Array, jax.Array, jax.Array],
+    ]
+
+
+def make_vec_env(spec: EnvSpec, n_envs: int, horizon: int):
+    """Batch ``spec`` over ``n_envs`` instances with auto-reset.
+
+    Returns ``(v_reset, v_step)``:
+
+    - ``v_reset(key) -> (state, obs)`` with ``state = {"phys": ..., "t": ...}``
+      (``t`` = per-env episode step counter) and ``obs`` shaped
+      ``(n_envs, *obs_shape)``;
+    - ``v_step(state, action, key) -> (state, obs, reward, done)`` where
+      ``done = terminated | (t >= horizon)`` per env and done slots come back
+      already reset (fresh physics, ``t=0``, reset obs). ``reward`` is the
+      raw per-transition reward, ``(n_envs,)`` — the caller applies
+      ``reward_scale``.
+
+    Both are pure and jit/scan-safe; under GSPMD the leading env axis shards
+    over the data mesh like any batch dimension.
+    """
+
+    def v_reset(key: jax.Array):
+        phys, obs = jax.vmap(spec.reset)(jax.random.split(key, n_envs))
+        return {"phys": phys, "t": jnp.zeros((n_envs,), jnp.int32)}, obs
+
+    def _masked_reset(done, phys, obs, key):
+        """Re-init done slots in place (where(), so live envs keep state)."""
+        phys_r, obs_r = jax.vmap(spec.reset)(jax.random.split(key, n_envs))
+        sel = lambda r, s: jnp.where(  # noqa: E731 — local broadcast helper
+            done.reshape((-1,) + (1,) * (s.ndim - 1)), r, s
+        )
+        return jax.tree.map(sel, phys_r, phys), sel(obs_r, obs)
+
+    def v_step(state, action: jax.Array, key: jax.Array):
+        k_step, k_reset = jax.random.split(key)
+        phys, obs, reward, term = jax.vmap(spec.step)(
+            state["phys"], action, jax.random.split(k_step, n_envs)
+        )
+        t = state["t"] + 1
+        done = term | (t >= horizon)
+        phys, obs = _masked_reset(done, phys, obs, k_reset)
+        t = jnp.where(done, 0, t)
+        return {"phys": phys, "t": t}, obs, reward, done
+
+    return v_reset, v_step

@@ -1,0 +1,76 @@
+"""Jittable Pendulum-v1 dynamics.
+
+Transcribes gymnasium's reference physics
+(``gymnasium/envs/classic_control/pendulum.py``): semi-implicit Euler at
+``dt=0.05`` with ``g=10, m=1, l=1``, torque clipped to ``[-2, 2]``, angular
+velocity clipped to ``[-8, 8]``, cost
+``angle_normalize(theta)^2 + 0.1*thdot^2 + 0.001*u^2`` computed from the
+PRE-step state, reset ``theta ~ U(-pi, pi)``, ``thdot ~ U(-1, 1)``. The env
+never terminates — gymnasium truncates at 200 steps, which colocated runs
+express as ``Config.time_horizon=200``.
+
+State is ``(2,)`` f32 ``[theta, theta_dot]``; the observation is
+``[cos(theta), sin(theta), theta_dot]``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from tpu_rl.envs.core import EnvSpec
+
+MAX_SPEED = 8.0
+MAX_TORQUE = 2.0
+DT = 0.05
+G = 10.0
+M = 1.0
+L = 1.0
+
+
+def _angle_normalize(x):
+    return ((x + math.pi) % (2 * math.pi)) - math.pi
+
+
+def _obs(state: jax.Array) -> jax.Array:
+    theta, theta_dot = state
+    return jnp.stack([jnp.cos(theta), jnp.sin(theta), theta_dot])
+
+
+def reset(key: jax.Array):
+    k1, k2 = jax.random.split(key)
+    theta = jax.random.uniform(
+        k1, (), jnp.float32, minval=-math.pi, maxval=math.pi
+    )
+    theta_dot = jax.random.uniform(k2, (), jnp.float32, minval=-1.0, maxval=1.0)
+    state = jnp.stack([theta, theta_dot])
+    return state, _obs(state)
+
+
+def step(state: jax.Array, action: jax.Array, key: jax.Array):
+    del key  # deterministic dynamics; key kept for the EnvSpec contract
+    theta, theta_dot = state
+    u = jnp.clip(action.reshape(()), -MAX_TORQUE, MAX_TORQUE)
+    cost = (
+        _angle_normalize(theta) ** 2 + 0.1 * theta_dot**2 + 0.001 * u**2
+    )
+    theta_dot = theta_dot + (
+        3.0 * G / (2.0 * L) * jnp.sin(theta) + 3.0 / (M * L**2) * u
+    ) * DT
+    theta_dot = jnp.clip(theta_dot, -MAX_SPEED, MAX_SPEED)
+    theta = theta + theta_dot * DT  # semi-implicit: new rate advances angle
+    state = jnp.stack([theta, theta_dot])
+    return state, _obs(state), -cost, jnp.bool_(False)
+
+
+PENDULUM = EnvSpec(
+    name="Pendulum-v1",
+    obs_shape=(3,),
+    action_space=1,
+    is_continuous=True,
+    gym_horizon=200,
+    reset=reset,
+    step=step,
+)

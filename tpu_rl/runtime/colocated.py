@@ -1,0 +1,424 @@
+"""Colocated (Anakin-mode) driver: envs on-device, one fused program.
+
+Podracer/Anakin (PAPERS.md, arxiv 2104.06272) colocates environments with
+the learner on the same accelerator: ``act -> env.step -> train`` compiles
+into ONE jitted program, so a training iteration is a single XLA dispatch
+with zero host<->device traffic and none of the distributed plane's
+worker/relay/storage machinery. This module is that mode for jittable envs
+(``tpu_rl/envs``); the distributed path stays the default for real
+(host-side) simulators.
+
+The fused program per iteration:
+
+1. ``lax.scan`` over ``cfg.seq_len`` acting ticks. Each tick reproduces the
+   distributed worker's tick semantics EXACTLY (runtime/worker.py): store the
+   pre-step obs / pre-step carry / pre-tick ``is_fir``, act, step the env,
+   scale the reward, zero the carry on done (``where``, never multiply — NaN
+   safety), raise ``is_fir`` for the post-reset step. Auto-reset and the
+   ``time_horizon`` truncation live in ``envs.core.make_vec_env``.
+2. Transpose the scan's ``(S, B, w)`` stack to the learner's ``(B, S, w)``
+   :class:`~tpu_rl.types.Batch`. Because every env contributes exactly one
+   full window per scan with no cross-env interleaving, this IS what
+   ``data.assembler.RolloutAssembler`` would emit for the same transition
+   stream (``tests/test_colocated.py`` pins it bit-for-bit).
+3. Run the pure ``train_step(state, batch, key)`` from the algo registry —
+   the same function the distributed learner compiles — on the batch while
+   it is still on device.
+
+The env batch is the train batch (``batch_size`` envs, overridable via
+``Config.colocated_envs``), sharded over the data mesh like any learner
+batch; parameters are replicated and GSPMD inserts the gradient all-reduce.
+Episode bookkeeping (completed-episode count / return sum) is accumulated
+*on device* in a replicated ``stats`` tree so the steady-state loop does
+zero per-iteration host transfers; the host only fetches at log intervals.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpu_rl.config import Config
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.envs import get_spec, make_vec_env
+from tpu_rl.parallel.mesh import (
+    batch_sharding,
+    check_divisible,
+    make_mesh,
+    replicated,
+)
+from tpu_rl.types import BATCH_FIELDS, Batch
+from tpu_rl.utils.timer import ExecutionTimer
+
+
+def act_params(state) -> dict:
+    """Acting parameter tree for either train-state flavor (colocated mode is
+    on-policy-only, but keep the SAC shape for completeness)."""
+    if hasattr(state, "actor_params"):
+        return {"actor": state.actor_params}
+    return {"actor": state.params["actor"]}
+
+
+def resolve_colocated_config(cfg: Config) -> Config:
+    """Apply the colocated-mode config overrides: ``colocated_envs`` replaces
+    ``batch_size`` (the env batch IS the train batch), and the obs/action
+    spaces are derived from the jittable env spec (no gymnasium)."""
+    if cfg.colocated_envs:
+        cfg = cfg.replace(
+            batch_size=cfg.colocated_envs,
+            buffer_size=max(cfg.buffer_size, cfg.colocated_envs),
+        )
+    spec = get_spec(cfg.env)
+    return cfg.replace(
+        obs_shape=spec.obs_shape,
+        action_space=spec.action_space,
+        is_continuous=spec.is_continuous,
+    )
+
+
+class ColocatedLoop:
+    """Owns the fused act->step->train program and its device-resident state.
+
+    Two compiled entry points:
+
+    - :attr:`rollout` — ``(params, carry, key) -> (carry, batch, done, ret)``:
+      the acting scan alone. Used by tests (assembler equivalence) and the
+      bench's pure-rollout row.
+    - :attr:`program` — ``(state, carry, stats, k_roll, k_train) ->
+      (state, carry, stats, metrics)``: rollout + train fused. ``state``,
+      ``carry`` and ``stats`` are donated; the steady-state loop re-dispatches
+      on the device-resident outputs without any host hop.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        seed: int = 0,
+        max_updates: int | None = None,
+        stop_event=None,
+        heartbeat=None,
+    ):
+        cfg = resolve_colocated_config(cfg)
+        assert cfg.env_mode == "colocated", cfg.env_mode
+        self.cfg = cfg
+        self.seed = int(seed)
+        self.max_updates = max_updates
+        self._stop = stop_event
+        self._heartbeat = heartbeat
+
+        self.mesh = make_mesh(cfg.mesh_data)
+        check_divisible(cfg.batch_size, self.mesh)
+        self.spec = get_spec(cfg.env)
+        self._v_reset, self._v_step = make_vec_env(
+            self.spec, cfg.batch_size, cfg.time_horizon
+        )
+        key = jax.random.PRNGKey(self.seed)
+        k_build, self._k_base = jax.random.split(key)
+        from tpu_rl.algos.registry import get_algo
+
+        self.family, self.state, self._train_step = get_algo(cfg.algo).build(
+            cfg, k_build, self.mesh
+        )
+        self.layout = BatchLayout.from_config(cfg)
+
+        rs, bs = replicated(self.mesh), batch_sharding(self.mesh)
+        self._rs, self._bs = rs, bs
+        # Every rollout output is batch-leading, so one sharding prefix
+        # covers carry, batch, done and ret alike.
+        self.rollout = jax.jit(
+            self._rollout_body,
+            in_shardings=(rs, bs, rs),
+            out_shardings=bs,
+            donate_argnums=(1,),
+        )
+        self.program = jax.jit(
+            self._program_body,
+            in_shardings=(rs, bs, rs, rs, rs),
+            out_shardings=(rs, bs, rs, rs),
+            donate_argnums=(0, 1, 2),
+        )
+
+        # Telemetry plane (same knobs/ports as every other role; satellite of
+        # the obs registry — nothing is constructed when the plane is off).
+        self.aggregator = None
+        self._http = None
+        self._json_exp = None
+        self._setup_telemetry()
+
+    # ------------------------------------------------------------ device init
+    def init_carry(self, key: jax.Array) -> dict:
+        """Fresh device carry: reset envs, zero recurrent state, ``is_fir=1``
+        (every env starts an episode), zero running returns."""
+        env, obs = self._v_reset(key)
+        n = self.cfg.batch_size
+        hw, cw = self.family.carry_widths
+        carry = {
+            "env": env,
+            # copy: for state==obs envs (CartPole) reset returns ONE array for
+            # both leaves, and the donated program rejects aliased buffers.
+            "obs": jnp.array(obs, copy=True),
+            "h": jnp.zeros((n, hw), jnp.float32),
+            "c": jnp.zeros((n, cw), jnp.float32),
+            "is_fir": jnp.ones((n,), jnp.float32),
+            "ret": jnp.zeros((n,), jnp.float32),
+        }
+        return jax.device_put(carry, self._bs)
+
+    def init_stats(self) -> dict:
+        return jax.device_put(
+            {
+                "episodes": jnp.zeros((), jnp.int32),
+                "ret_sum": jnp.zeros((), jnp.float32),
+            },
+            self._rs,
+        )
+
+    # -------------------------------------------------------------- jit bodies
+    def _tick(self, params, cr: dict, k: jax.Array):
+        """One acting tick — the worker loop's body as pure jax."""
+        cfg, family = self.cfg, self.family
+        k_act, k_env = jax.random.split(k)
+        a, logits, log_prob, h2, c2 = family.act(
+            params, cr["obs"], cr["h"], cr["c"], k_act
+        )
+        env, obs2, rew, done = self._v_step(cr["env"], a, k_env)
+        ret2 = cr["ret"] + rew
+        if family.store_carry:
+            hx, cx = cr["h"], cr["c"]
+        else:
+            n = cfg.batch_size
+            hx = jnp.zeros((n, self.layout.width("hx")), jnp.float32)
+            cx = jnp.zeros((n, self.layout.width("cx")), jnp.float32)
+        ys = dict(
+            obs=cr["obs"],
+            act=a,
+            rew=(rew * cfg.reward_scale)[:, None].astype(jnp.float32),
+            logits=logits,
+            log_prob=log_prob,
+            is_fir=cr["is_fir"][:, None],
+            hx=hx,
+            cx=cx,
+            done=done,
+            # Completed-episode RAW return, emitted on the terminal tick.
+            ep_ret=jnp.where(done, ret2, 0.0),
+        )
+        keep = (~done)[:, None]
+        cr2 = {
+            "env": env,
+            "obs": obs2,
+            # where(), not multiply: a NaN carry from a diverged net must not
+            # survive the reset (same guard as the worker).
+            "h": jnp.where(keep, h2, 0.0),
+            "c": jnp.where(keep, c2, 0.0),
+            "is_fir": done.astype(jnp.float32),
+            "ret": jnp.where(done, 0.0, ret2),
+        }
+        return cr2, ys
+
+    def _rollout_body(self, params, carry: dict, key: jax.Array):
+        keys = jax.random.split(key, self.cfg.seq_len)
+        carry, ys = jax.lax.scan(
+            lambda cr, k: self._tick(params, cr, k), carry, keys
+        )
+        swap = lambda x: jnp.swapaxes(x, 0, 1)  # noqa: E731 — (S,B,w)->(B,S,w)
+        batch = Batch(**{f: swap(ys[f]) for f in BATCH_FIELDS})
+        return carry, batch, swap(ys["done"]), swap(ys["ep_ret"])
+
+    def _program_body(self, state, carry, stats, k_roll, k_train):
+        # Register the mesh only while this body traces, so LSTM unrolls emit
+        # the fused Pallas kernel as a shard_map island over the data axis
+        # (same dance as parallel.dp.make_parallel_train_step).
+        from tpu_rl.models import cells
+
+        prev = cells._DATA_MESH
+        cells.set_data_mesh(self.mesh)
+        try:
+            carry, batch, done, ep_ret = self._rollout_body(
+                act_params(state), carry, k_roll
+            )
+            state, metrics = self._train_step(state, batch, k_train)
+        finally:
+            cells.set_data_mesh(prev)
+        stats = {
+            "episodes": stats["episodes"] + done.sum(dtype=jnp.int32),
+            "ret_sum": stats["ret_sum"] + ep_ret.sum(),
+        }
+        return state, carry, stats, metrics
+
+    # ---------------------------------------------------------------- telemetry
+    def _setup_telemetry(self) -> None:
+        cfg = self.cfg
+        if not cfg.telemetry_enabled:
+            return
+        from tpu_rl.obs import (
+            JsonExporter,
+            MetricsRegistry,
+            TelemetryAggregator,
+            TelemetryHTTPServer,
+        )
+
+        self.aggregator = TelemetryAggregator(
+            registry=MetricsRegistry(role="colocated"),
+            stale_after_s=cfg.telemetry_stale_s,
+        )
+        if cfg.telemetry_port > 0:
+            self._http = TelemetryHTTPServer(self.aggregator, cfg.telemetry_port)
+        if cfg.result_dir is not None:
+            self._json_exp = JsonExporter(
+                self.aggregator,
+                os.path.join(cfg.result_dir, "telemetry.json"),
+                interval_s=cfg.telemetry_interval_s,
+            )
+
+    def _telemetry_tick(
+        self,
+        updates: int,
+        env_steps: int,
+        episodes: int,
+        ups: float,
+        tps: float,
+        chunk_s: float,
+        mean_ret: float,
+    ) -> None:
+        if self.aggregator is None:
+            return
+        reg = self.aggregator.registry
+        reg.counter("colocated-updates").set_total(updates)
+        reg.counter("colocated-env-steps").set_total(env_steps)
+        reg.counter("colocated-episodes").set_total(episodes)
+        reg.gauge("colocated-updates-per-s").set(ups)
+        reg.gauge("colocated-env-steps-per-s").set(tps)
+        reg.gauge("colocated-mean-episode-return").set(mean_ret)
+        reg.histogram("colocated-scan-chunk-s").observe(chunk_s)
+        if self._json_exp is not None:
+            self._json_exp.maybe_export()
+
+    def close(self) -> None:
+        if self._http is not None:
+            self._http.close()
+        if self._json_exp is not None:
+            # Force a final write regardless of the exporter's cadence.
+            self._json_exp.maybe_export(now=float("inf"))
+
+    # ---------------------------------------------------------------- run loop
+    def _stopping(self) -> bool:
+        return self._stop is not None and self._stop.is_set()
+
+    def run(self, log: bool = True) -> dict:
+        """Drive the fused program to ``max_updates`` (or until the stop
+        event). Returns a summary dict with run totals and timer scalars."""
+        cfg = self.cfg
+        n, s = cfg.batch_size, cfg.seq_len
+        timer = ExecutionTimer(num_transition=n * s)
+        from tpu_rl.utils.metrics import make_writer
+
+        writer = make_writer(cfg.result_dir)
+        k_carry = jax.random.fold_in(self._k_base, 0xC0C0)
+        from tpu_rl.parallel.dp import replicate
+
+        state = replicate(self.state, self.mesh)
+        carry = self.init_carry(k_carry)
+        stats = self.init_stats()
+        metrics: Any = {}
+        log_every = max(1, cfg.loss_log_interval)
+        it = 0
+        last_it, last_ep, last_ret = 0, 0, 0.0
+        mean_ret, best_ret = 0.0, float("-inf")
+        t_mark = time.perf_counter()
+        t0 = t_mark
+        while not self._stopping() and (
+            self.max_updates is None or it < self.max_updates
+        ):
+            k_roll, k_train = jax.random.split(
+                jax.random.fold_in(self._k_base, it)
+            )
+            state, carry, stats, metrics = self.program(
+                state, carry, stats, k_roll, k_train
+            )
+            it += 1
+            if self._heartbeat is not None:
+                self._heartbeat.value = time.time()
+            if it % log_every and it != self.max_updates:
+                continue
+            # device_get blocks on iteration `it`, so the wall-clock delta
+            # below covers real device work (dispatch is async in between).
+            host_stats = jax.device_get(stats)
+            host_metrics = {
+                k: float(v) for k, v in jax.device_get(metrics).items()
+            }
+            now = time.perf_counter()
+            iters = it - last_it
+            chunk_s = (now - t_mark) / max(1, iters)
+            timer.record("colocated-iteration", chunk_s, check_throughput=True)
+            ups = iters / max(now - t_mark, 1e-9)
+            tps = ups * n * s
+            episodes = int(host_stats["episodes"])
+            ret_sum = float(host_stats["ret_sum"])
+            if episodes > last_ep:
+                mean_ret = (ret_sum - last_ret) / (episodes - last_ep)
+                best_ret = max(best_ret, mean_ret)
+            self._telemetry_tick(
+                it, it * n * s, episodes, ups, tps, chunk_s, mean_ret
+            )
+            for name, val in host_metrics.items():
+                writer.add_scalar(f"loss/{name}", val, it)
+            writer.add_scalar("colocated/env_steps_per_s", tps, it)
+            writer.add_scalar("colocated/mean_episode_return", mean_ret, it)
+            if log:
+                print(
+                    f"[colocated] update {it}  tps {tps:,.0f}  "
+                    f"episodes {episodes}  mean_return {mean_ret:.1f}  "
+                    + "  ".join(
+                        f"{k} {v:.4f}" for k, v in host_metrics.items()
+                    ),
+                    flush=True,
+                )
+            last_it, last_ep, last_ret = it, episodes, ret_sum
+            t_mark = time.perf_counter()
+        host_stats = jax.device_get(stats)
+        elapsed = time.perf_counter() - t0
+        writer.flush()
+        writer.close()
+        self.close()
+        episodes = int(host_stats["episodes"])
+        ret_sum = float(host_stats["ret_sum"])
+        return {
+            "updates": it,
+            "env_steps": it * n * s,
+            "episodes": episodes,
+            "mean_return_overall": ret_sum / max(1, episodes),
+            "mean_return_recent": mean_ret,
+            # Max over per-log-window completed-episode means: the stable
+            # "did it learn" signal (on-policy curves oscillate after peak).
+            "mean_return_best_window": best_ret,
+            "elapsed_s": elapsed,
+            "transitions_per_s": it * n * s / max(elapsed, 1e-9),
+            "scalars": timer.scalars(),
+        }
+
+
+def colocated_main(
+    cfg: Config, stop_event, heartbeat, max_updates: int | None = None,
+    seed: int = 0,
+) -> None:
+    """Supervised child entry: the whole colocated deployment is this one
+    process (supervisor spawns it via ``runner.colocated_role``)."""
+    loop = ColocatedLoop(
+        cfg,
+        seed=seed,
+        max_updates=max_updates,
+        stop_event=stop_event,
+        heartbeat=heartbeat,
+    )
+    out = loop.run()
+    print(
+        f"[colocated] done: {out['updates']} updates, "
+        f"{out['env_steps']:,} env steps, {out['episodes']} episodes, "
+        f"mean return {out['mean_return_overall']:.1f}, "
+        f"{out['transitions_per_s']:,.0f} transitions/s",
+        flush=True,
+    )

@@ -20,7 +20,22 @@ from tpu_rl.config import Config
 
 def probe_spaces(cfg: Config) -> Config:
     """Fill runtime-derived obs/action-space fields by probing the env once
-    (reference ``main.py:82-95``)."""
+    (reference ``main.py:82-95``).
+
+    Colocated mode reads the spaces off the jittable env spec instead —
+    no ``gym.make``, no gymnasium import at all: the spec IS the env, so
+    constructing a throwaway host env just to read its spaces would be
+    pure overhead (and a hard dependency colocated deployments don't need).
+    """
+    if cfg.env_mode == "colocated":
+        from tpu_rl.envs import get_spec
+
+        spec = get_spec(cfg.env)
+        return cfg.replace(
+            obs_shape=spec.obs_shape,
+            action_space=spec.action_space,
+            is_continuous=spec.is_continuous,
+        )
     import gymnasium as gym
 
     env = gym.make(cfg.env)

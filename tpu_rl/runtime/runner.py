@@ -487,6 +487,33 @@ def manager_role(
     return sup
 
 
+def colocated_role(
+    cfg: Config,
+    machines: MachinesConfig | None = None,
+    supervisor: Supervisor | None = None,
+    max_updates: int | None = None,
+    seed: int = 0,
+) -> Supervisor:
+    """Spawn the fused Anakin-mode loop (``runtime/colocated.py``): envs live
+    on the accelerator inside the jitted train program, so the whole
+    deployment is ONE supervised child — no storage, manager or workers.
+    ``machines`` is accepted (and ignored) so the CLI can dispatch every role
+    through one signature."""
+    del machines  # colocated mode has no fleet topology
+    from tpu_rl.runtime.colocated import colocated_main
+
+    sup = supervisor or Supervisor.from_config(cfg)
+    sup.spawn(
+        "colocated",
+        functools.partial(colocated_main, max_updates=max_updates, seed=seed),
+        cfg,
+        # "auto": the fused program owns the accelerator. "cpu": force the
+        # CPU backend (CI, or when another process holds the chip).
+        cpu_only=(cfg.learner_device == "cpu"),
+    )
+    return sup
+
+
 def local_cluster(
     cfg: Config,
     machines: MachinesConfig | None = None,
@@ -496,9 +523,14 @@ def local_cluster(
 ) -> Supervisor:
     """Everything on one host: learner + storage + manager + workers under a
     single supervisor. The smallest real deployment and the integration-test
-    harness."""
+    harness. In colocated mode the "cluster" collapses to the single fused
+    child (``colocated_role``) — same entry point, same supervisor contract."""
     machines = machines or MachinesConfig()
     sup = Supervisor.from_config(cfg)
+    if cfg.env_mode == "colocated":
+        return colocated_role(
+            cfg, machines, supervisor=sup, max_updates=max_updates, seed=seed
+        )
     learner_role(
         cfg,
         machines,
