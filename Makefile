@@ -2,8 +2,8 @@
 
 PY ?= python
 
-.PHONY: lint format-check test relay-smoke obs-smoke trace-smoke chaos-smoke \
-	colocated-smoke ci
+.PHONY: lint format-check test native-build protocol-matrix relay-smoke \
+	obs-smoke trace-smoke chaos-smoke colocated-smoke ci
 
 lint:
 	ruff check .
@@ -15,6 +15,29 @@ format-check:
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Build (and cache) the native codec from source, then prove it loaded —
+# CI must never silently fall back to the zlib/Python path.
+native-build:
+	JAX_PLATFORMS=cpu $(PY) -c "from tpu_rl.runtime import native; \
+		assert native.available(), 'native codec failed to build'; \
+		print('native codec OK:', native.LIB._name)"
+
+# Wire-protocol + relay + chaos suites twice: once with the native codec
+# force-disabled (TPU_RL_NATIVE=0 exercises the pure-Python fallback every
+# deployment without a toolchain runs) and once against the freshly built
+# library — both paths must hold the same contracts.
+protocol-matrix: native-build
+	JAX_PLATFORMS=cpu TPU_RL_NATIVE=0 $(PY) -m pytest -q \
+		tests/test_protocol.py tests/test_relay_raw.py \
+		tests/test_relay_units.py tests/test_native_validate.py \
+		tests/test_shm_transport.py tests/test_chaos.py \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest -q \
+		tests/test_protocol.py tests/test_relay_raw.py \
+		tests/test_relay_units.py tests/test_native_validate.py \
+		tests/test_shm_transport.py tests/test_chaos.py \
+		-p no:cacheprovider
 
 # Fan-in A/B smoke: short raw-vs-decode run through the real Manager +
 # LearnerStorage. Asserts direction only (raw >= decode frames/s) — never a
@@ -49,4 +72,5 @@ chaos-smoke:
 colocated-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/colocated_smoke.py
 
-ci: lint test relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke
+ci: lint test protocol-matrix relay-smoke obs-smoke trace-smoke chaos-smoke \
+	colocated-smoke

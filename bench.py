@@ -34,7 +34,9 @@ prefetched data plane (``run_e2e_compare`` -> ``bench_e2e_feed[.cpu].json``).
 
 ``TPU_RL_BENCH_RELAY=1 python bench.py`` runs the fan-in A/B: raw (zero-copy
 peek+forward relay, columnar push_tick ingest) vs decode baseline through the
-real Manager and LearnerStorage (``run_relay_compare`` ->
+real Manager and LearnerStorage, plus the ISSUE-8 rows — the shm
+manager->storage hop with native batch validation at the sink, and the
+native-vs-python frame-validation micro A/B (``run_relay_compare`` ->
 ``bench_relay[.cpu].json``; ``TPU_RL_BENCH_RELAY_LIGHT=1`` is the `make ci`
 smoke shape, asserting direction without writing numbers).
 """
@@ -365,6 +367,11 @@ def run_all(out_path: str | None = None) -> dict:
         "unit": "transitions/sec",
         "vs_baseline": round(headline["tps"] / REFERENCE_BASELINE_TPS, 2),
     }
+    relay = last_relay_record()
+    if relay is not None:
+        # Surface the committed fan-in numbers (host-side, so never stale
+        # w.r.t. the accelerator) alongside the learner headline.
+        out["relay"] = relay
     if on_cpu:
         # Flag CPU numbers loudly in the summary line itself: embed the
         # newest committed on-chip headline (marked stale) exactly as the
@@ -768,37 +775,56 @@ def _relay_tick_payload(n_envs: int = 32, hidden: int = 64) -> dict:
 
 
 def relay_forward_row(mode: str, base_port: int, duration: float,
-                      payload: dict) -> dict:
+                      payload: dict, transport: str = "tcp",
+                      paced: bool = False) -> dict:
     """Frames/s through a REAL Manager over real ZMQ: a producer PUB floods
     pre-encoded RolloutBatch frames at the manager's worker port while a
     sink SUB (bound where storage binds) counts what comes out the other
     side. The producer and sink are identical across modes — the only
     variable is the manager's per-frame work: peek+forward (raw) vs
-    decode+re-encode (decode)."""
+    decode+re-encode (decode).
+
+    ``transport="shm"`` re-plumbs the manager->storage hop exactly as
+    ``Config.transport="shm"`` does in production: the manager publishes
+    onto a shared-memory ring and the sink is the storage-side ``FanInSub``
+    draining in native-validated batches — ISSUE 8's fast path. The
+    worker->manager hop stays TCP in every row (workers may be remote).
+
+    ``paced=True`` bounds the producer's in-flight window instead of
+    flooding — on small hosts a flooding producer burns the core on frames
+    the HWM then drops, understating the relay. The committed tcp rows keep
+    the flooding producer so their numbers stay comparable across rounds."""
     import threading
 
     from tpu_rl.config import Config
     from tpu_rl.runtime.manager import Manager
     from tpu_rl.runtime.protocol import Protocol, encode
-    from tpu_rl.runtime.transport import Pub, Sub
+    from tpu_rl.runtime.transport import Pub, Sub, make_data_sub
 
     cfg = Config.from_dict(
         dict(algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=64,
-             relay_mode=mode)
+             relay_mode=mode, transport=transport)
     )
     worker_port, learner_port = base_port, base_port + 1
     stop = threading.Event()
     m = Manager(cfg, worker_port, "127.0.0.1", learner_port, stop_event=stop)
     mt = threading.Thread(target=m.run, daemon=True)
     mt.start()
-    sink = Sub("*", learner_port, bind=True)
+    if transport == "shm":
+        sink = make_data_sub(cfg, "*", learner_port, bind=True)
+    else:
+        sink = Sub("*", learner_port, bind=True)
     pub = Pub("127.0.0.1", worker_port, bind=False)
     frame = encode(Protocol.RolloutBatch, payload)
     send_stop = threading.Event()
     sent = [0]
+    settled = [0]  # paced mode: frames delivered or written off
 
     def produce() -> None:
         while not send_stop.is_set():
+            if paced and sent[0] - settled[0] > 512:
+                time.sleep(0.0002)
+                continue
             pub.send_raw(frame)
             sent[0] += 1
 
@@ -811,15 +837,32 @@ def relay_forward_row(mode: str, base_port: int, duration: float,
         primed = False
         while time.time() < deadline and not primed:
             primed = sink.recv_raw(timeout_ms=100) is not None
+            settled[0] = sent[0]  # slow-joiner losses settle, window reopens
         if not primed:
             raise RuntimeError(f"relay ({mode}) never forwarded a frame")
         n = nbytes = 0
         t0 = time.perf_counter()
-        while (dt := time.perf_counter() - t0) < duration:
-            got = sink.recv_raw(timeout_ms=20)
-            if got is not None:
-                n += 1
-                nbytes += len(got[1][0]) + len(got[1][1])
+        if transport == "shm":
+            # Storage's real consumption pattern on the shm hop: batch
+            # drains (one native validate call per batch), not per-frame
+            # polls — the tcp rows keep the committed per-frame loop so the
+            # baseline number stays comparable across rounds.
+            while (dt := time.perf_counter() - t0) < duration:
+                k = 0
+                for _, parts in sink.drain_raw(max_msgs=1024):
+                    n += 1
+                    k += 1
+                    nbytes += len(parts[0]) + len(parts[1])
+                settled[0] += k
+                if k == 0:
+                    time.sleep(0.0005)
+        else:
+            while (dt := time.perf_counter() - t0) < duration:
+                got = sink.recv_raw(timeout_ms=20)
+                if got is not None:
+                    n += 1
+                    settled[0] += 1
+                    nbytes += len(got[1][0]) + len(got[1][1])
     finally:
         send_stop.set()
         pt.join(timeout=5)
@@ -830,6 +873,8 @@ def relay_forward_row(mode: str, base_port: int, duration: float,
     n_envs = len(payload["id"])
     return dict(
         mode=mode,
+        transport=transport,
+        paced=paced,
         frames_per_s=round(n / dt, 1),
         env_steps_per_s=round(n * n_envs / dt, 1),
         wire_mb_per_s=round(nbytes / dt / 1e6, 2),
@@ -881,6 +926,137 @@ def ingest_row(mode: str, n_ticks: int, payload: dict) -> dict:
     )
 
 
+def hop_row(transport: str, base_port: int, duration: float,
+            payload: dict) -> dict:
+    """The manager->storage hop in isolation (no Manager in the loop): a
+    sender thread pushes pre-encoded frames the way the manager's forward
+    loop does (``send_raw`` per frame, bounded in-flight window) while the
+    storage-side sink drains in native-validated batches. This is the hop
+    ISSUE 8 re-plumbs — the A/B that shows whether the fan-in edge itself
+    is still the bottleneck: tcp = ZMQ PUB->SUB, shm = ring + FanInSub."""
+    import threading
+
+    from tpu_rl.runtime.protocol import Protocol, encode
+    from tpu_rl.runtime.transport import FanInSub, Pub, ShmPub, Sub
+
+    frame = encode(Protocol.RolloutBatch, payload)
+    if transport == "shm":
+        sink = FanInSub("*", base_port, bind=True)
+        pub = ShmPub(base_port)
+    else:
+        sink = Sub("*", base_port, bind=True)
+        pub = Pub("127.0.0.1", base_port, bind=False)
+    stop = threading.Event()
+    sent = [0]
+    settled = [0]
+
+    def produce() -> None:
+        while not stop.is_set():
+            if sent[0] - settled[0] > 512:
+                time.sleep(0.0002)
+                continue
+            pub.send_raw(frame)
+            sent[0] += 1
+
+    pt = threading.Thread(target=produce, daemon=True)
+    pt.start()
+    try:
+        deadline = time.time() + 30
+        primed = False
+        while time.time() < deadline and not primed:
+            primed = sink.recv_raw(timeout_ms=100) is not None
+            settled[0] = sent[0]
+        if not primed:
+            raise RuntimeError(f"hop ({transport}) never delivered a frame")
+        n = nbytes = 0
+        t0 = time.perf_counter()
+        while (dt := time.perf_counter() - t0) < duration:
+            k = 0
+            for _, parts in sink.drain_raw(max_msgs=1024):
+                n += 1
+                k += 1
+                nbytes += len(parts[0]) + len(parts[1])
+            settled[0] += k
+            if k == 0:
+                time.sleep(0.0005)
+    finally:
+        stop.set()
+        pt.join(timeout=5)
+        sink.close()
+        pub.close()
+    n_envs = len(payload["id"])
+    return dict(
+        transport=transport,
+        frames_per_s=round(n / dt, 1),
+        env_steps_per_s=round(n * n_envs / dt, 1),
+        wire_mb_per_s=round(nbytes / dt / 1e6, 2),
+        frames_delivered=n,
+        frames_sent=sent[0],
+        seconds=round(dt, 2),
+    )
+
+
+def validate_batch_row(use_native: bool, grade: str, n_frames: int,
+                       reps: int, payload: dict) -> dict:
+    """Frame VALIDATION throughput, no sockets and no decode: one batched
+    native ``tpurl_validate_batch[_crc]`` call vs the per-frame Python
+    checks it replaces, over identical pre-encoded traced RolloutBatch
+    frames. ``grade="peek"`` is the relay-edge check (header + trailer
+    structure); ``grade="crc"`` adds the body crc32 the storage edge pays.
+    Decompress+unpack run in Python on both paths in production, so they
+    are excluded here — this row isolates exactly what the native call
+    buys."""
+    import zlib as _zlib
+
+    from tpu_rl.runtime import native
+    from tpu_rl.runtime.protocol import (
+        _HEADER, MAX_PROTO, Protocol, TRACE_KINDS_MASK, encode,
+        make_trace_id, pack_trace, peek,
+    )
+
+    mode = "native" if use_native else "python"
+    if use_native and not native.available():
+        return dict(mode=mode, grade=grade, error="native codec unavailable")
+    trailer = pack_trace(1, 0, make_trace_id(1, 0), 0)
+    frames = [encode(Protocol.RolloutBatch, payload, trace=trailer)
+              for _ in range(n_frames)]
+
+    def py_pass() -> int:
+        ok = 0
+        for parts in frames:
+            try:
+                peek(parts)
+            except ValueError:
+                continue
+            if grade == "crc":
+                crc = _HEADER.unpack_from(parts[1])[4]
+                if _zlib.crc32(parts[1][_HEADER.size:]) & 0xFFFFFFFF != crc:
+                    continue
+            ok += 1
+        return ok
+
+    def native_pass() -> int:
+        verdicts = native.validate_batch(
+            frames, TRACE_KINDS_MASK, MAX_PROTO, check_crc=(grade == "crc")
+        )
+        return sum(1 for v in verdicts if v == 0)
+
+    run_pass = native_pass if use_native else py_pass
+    assert run_pass() == n_frames  # warm-up + sanity
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run_pass()
+    dt = time.perf_counter() - t0
+    return dict(
+        mode=mode,
+        grade=grade,
+        frames_per_s=round(n_frames * reps / dt, 1),
+        batch=n_frames,
+        reps=reps,
+        seconds=round(dt, 3),
+    )
+
+
 def run_relay_compare(
     duration: float | None = None,
     ingest_ticks: int | None = None,
@@ -911,6 +1087,34 @@ def run_relay_compare(
         rows.append(row)
         print(json.dumps(row), file=sys.stderr, flush=True)
     dec, raw = rows
+    # ISSUE 8 rows. (a) e2e through the real Manager with the shm
+    # manager->storage hop + native batch drains at the sink (paced
+    # producer: on small hosts the flooding producer starves the relay).
+    shm = dict(relay=relay_forward_row(
+        "raw", base_port + 20, duration, payload, transport="shm", paced=True
+    ))
+    rows.append(shm)
+    print(json.dumps(shm), file=sys.stderr, flush=True)
+    # (b) The manager->storage hop in isolation, tcp vs shm — the A/B the
+    # acceptance bar is stated against (is the fan-in edge the bottleneck?).
+    hops = {
+        tr: hop_row(tr, base_port + 30 + 2 * j, duration, payload)
+        for j, tr in enumerate(("tcp", "shm"))
+    }
+    rows.append(dict(hop=hops))
+    print(json.dumps(hops), file=sys.stderr, flush=True)
+    # (c) Native-vs-python frame validation, both grades, no sockets.
+    v_reps = 20 if light else 200
+    validate = {
+        grade: {
+            mode: validate_batch_row(mode == "native", grade, 256, v_reps,
+                                     payload)
+            for mode in ("native", "python")
+        }
+        for grade in ("peek", "crc")
+    }
+    rows.append(dict(validate=validate))
+    print(json.dumps(validate), file=sys.stderr, flush=True)
     fps_speedup = (
         raw["relay"]["frames_per_s"] / dec["relay"]["frames_per_s"]
         if dec["relay"]["frames_per_s"] else None
@@ -919,6 +1123,24 @@ def run_relay_compare(
         raw["ingest"]["env_steps_per_s"] / dec["ingest"]["env_steps_per_s"]
         if dec["ingest"]["env_steps_per_s"] else None
     )
+    shm_speedup = (
+        shm["relay"]["frames_per_s"] / raw["relay"]["frames_per_s"]
+        if raw["relay"]["frames_per_s"] else None
+    )
+    hop_speedup = (
+        hops["shm"]["frames_per_s"] / hops["tcp"]["frames_per_s"]
+        if hops["tcp"]["frames_per_s"] else None
+    )
+    hop_vs_relay = (
+        hops["shm"]["frames_per_s"] / raw["relay"]["frames_per_s"]
+        if raw["relay"]["frames_per_s"] else None
+    )
+
+    def _v_speedup(grade: str):
+        na = validate[grade]["native"].get("frames_per_s")
+        py = validate[grade]["python"].get("frames_per_s")
+        return round(na / py, 2) if na and py else None
+
     result = {
         "metric": "manager relay frames/s, raw vs decode",
         "n_envs": n_envs,
@@ -930,6 +1152,16 @@ def run_relay_compare(
         "decode_frames_per_s": dec["relay"]["frames_per_s"],
         "raw_ingest_env_steps_per_s": raw["ingest"]["env_steps_per_s"],
         "decode_ingest_env_steps_per_s": dec["ingest"]["env_steps_per_s"],
+        "shm_frames_per_s": shm["relay"]["frames_per_s"],
+        "shm_vs_raw_speedup": round(shm_speedup, 2) if shm_speedup else None,
+        "hop_tcp_frames_per_s": hops["tcp"]["frames_per_s"],
+        "hop_shm_frames_per_s": hops["shm"]["frames_per_s"],
+        "hop_shm_speedup": round(hop_speedup, 2) if hop_speedup else None,
+        "hop_shm_vs_raw_relay": (
+            round(hop_vs_relay, 2) if hop_vs_relay else None
+        ),
+        "validate_speedup": _v_speedup("crc"),
+        "validate_peek_speedup": _v_speedup("peek"),
         "light": light,
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "rows": rows,
@@ -938,6 +1170,12 @@ def run_relay_compare(
         # CI smoke contract: direction only, never a committed number.
         assert raw["relay"]["frames_per_s"] >= dec["relay"]["frames_per_s"], (
             f"raw relay slower than decode: {result}"
+        )
+        assert shm["relay"]["frames_per_s"] > 0, (
+            f"shm relay forwarded nothing: {result}"
+        )
+        assert hops["shm"]["frames_per_s"] > 0, (
+            f"shm hop delivered nothing: {result}"
         )
         return result
     if out_path is None:
@@ -1135,6 +1373,38 @@ def last_good_onchip(path: str | None = None) -> dict | None:
             for r in rows
         ],
     }
+
+
+def last_relay_record(path: str | None = None) -> dict | None:
+    """Summary of the newest committed non-light relay A/B
+    (``bench_relay[.cpu].json``) — same carry-the-evidence pattern as
+    :func:`last_good_onchip`, so the run_all summary line surfaces the
+    fan-in numbers (raw vs decode, shm hop, native validation) without
+    re-running the relay harness every time."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = [path] if path else [
+        os.path.join(here, "bench_relay.json"),
+        os.path.join(here, "bench_relay.cpu.json"),
+    ]
+    for p in paths:
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if rec.get("light"):
+            continue  # CI smoke shapes are direction-only, not numbers
+        return {
+            k: rec.get(k)
+            for k in (
+                "raw_frames_per_s", "decode_frames_per_s",
+                "relay_frames_speedup", "shm_frames_per_s",
+                "shm_vs_raw_speedup", "hop_shm_frames_per_s",
+                "hop_shm_speedup", "hop_shm_vs_raw_relay",
+                "validate_speedup", "validate_peek_speedup", "recorded_at",
+            )
+        }
+    return None
 
 
 if __name__ == "__main__":

@@ -12,6 +12,11 @@ Both legs of the ISSUE-3 A/B run per mode:
   ``push_tick`` + ``put_many`` (raw) vs ``split_rollout_batch`` + per-step
   ``push`` + per-window ``put`` (decode), in env-steps/s.
 
+ISSUE-8 rows ride along: an shm-transport relay leg (same Manager, the
+storage hop over shared-memory rings), an isolated manager→storage hop A/B
+(tcp vs shm, no manager in the loop), and a native-vs-python frame
+validation micro A/B at peek and CRC grade.
+
 Host-side benchmark (manager and storage never touch the accelerator):
   JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/bench_relay.py \
       [--duration 4.0] [--ticks 3000] [--envs 32] [--port 29940] \

@@ -1,0 +1,218 @@
+"""Native batch validation (ISSUE 8 tentpole a): the C++ ``tpurl_validate_batch``
+verdicts must match the Python ``peek``/``decode`` path frame-for-frame — the
+native fast path is only sound if it rejects exactly what Python rejects.
+Covers the full verdict enum, CRC-grade vs peek-grade validation, and the
+module-level batch-drain helpers the Sub/FanInSub drains are built on."""
+
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from tpu_rl.runtime import native, transport
+from tpu_rl.runtime.protocol import (
+    _HEADER,
+    _MAGIC,
+    _VERSION,
+    Codec,
+    MAX_PROTO,
+    Protocol,
+    TRACE_KINDS_MASK,
+    decode,
+    encode,
+    make_trace_id,
+    pack_trace,
+    peek,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native codec not built"
+)
+
+# Verdict codes from native/codec.cpp (pinned by the ABI comment there).
+OK, BAD_PARTS, BAD_PROTO, SHORT = 0, 1, 2, 3
+BAD_MAGIC, OVERSIZED, RAW_MISMATCH, BAD_CODEC = 4, 5, 6, 7
+BAD_TRAILER, BAD_CRC = 8, 9
+
+
+def _good(payload={"x": 1}, proto=Protocol.RolloutBatch, trace=None):
+    return encode(proto, payload, trace=trace)
+
+
+def _trailer():
+    return pack_trace(3, 41, make_trace_id(3, 41), 123_456_789)
+
+
+def _corrupt_body(parts):
+    """Flip one body byte past the 12-byte header: framing stays valid, the
+    CRC does not."""
+    frame = bytearray(parts[1])
+    frame[12] ^= 0xFF
+    return [parts[0], bytes(frame)]
+
+
+def _matrix():
+    """(frames, peek_verdicts, crc_verdicts) — one frame per failure mode."""
+    big = {"obs": np.arange(256, dtype=np.float32)}
+    bad_magic = bytearray(_good()[1])
+    bad_magic[0] ^= 0xFF
+    oversized = _HEADER.pack(_MAGIC, _VERSION, Codec.ZLIB, (1 << 30) + 1, 0)
+    raw_frame = encode(Protocol.Stat, 2.5)  # tiny payloads ship codec=raw
+    hdr = _HEADER.unpack_from(raw_frame[1])
+    assert hdr[2] == Codec.RAW
+    mismatch = _HEADER.pack(_MAGIC, _VERSION, Codec.RAW, hdr[3] + 7, hdr[4])
+    bad_codec = _HEADER.pack(_MAGIC, _VERSION, 9, hdr[3], hdr[4])
+    body = raw_frame[1][_HEADER.size:]
+    traced = _good(big, Protocol.Rollout, trace=_trailer())
+    bad_trailer = bytearray(_trailer())
+    bad_trailer[0] ^= 0xFF
+    frames = [
+        _good(big),                                       # 0 ok, 2 parts
+        traced,                                           # 1 ok, 3 parts
+        raw_frame,                                        # 2 ok, codec=raw
+        [],                                               # 3 bad part count
+        [bytes([99]), _good()[1]],                        # 4 unknown proto
+        [bytes([1]), b"tiny"],                            # 5 short frame
+        [bytes([3]), bytes(bad_magic)],                   # 6 bad magic
+        [bytes([3]), oversized + b"x"],                   # 7 oversized raw
+        [bytes([0]), mismatch + body],                    # 8 raw size mismatch
+        [bytes([0]), bad_codec + body],                   # 9 unknown codec
+        _corrupt_body(_good(big)),                        # 10 body crc broken
+        [raw_frame[0], raw_frame[1], _trailer()],         # 11 trailer on Stat
+        [traced[0], traced[1], _trailer()[:20]],          # 12 truncated trailer
+        [traced[0], traced[1], bytes(bad_trailer)],       # 13 bad trailer magic
+    ]
+    peek_v = [OK, OK, OK, BAD_PARTS, BAD_PROTO, SHORT, BAD_MAGIC, OVERSIZED,
+              RAW_MISMATCH, BAD_CODEC, OK, BAD_TRAILER, BAD_TRAILER,
+              BAD_TRAILER]
+    crc_v = list(peek_v)
+    crc_v[10] = BAD_CRC  # only the crc-grade pass catches the flipped byte
+    return frames, peek_v, crc_v
+
+
+@needs_native
+class TestBatchVerdicts:
+    def test_peek_grade_matrix(self):
+        frames, peek_v, _ = _matrix()
+        got = native.validate_batch(frames, TRACE_KINDS_MASK, MAX_PROTO)
+        assert got == peek_v
+
+    def test_crc_grade_matrix(self):
+        frames, _, crc_v = _matrix()
+        got = native.validate_batch(
+            frames, TRACE_KINDS_MASK, MAX_PROTO, check_crc=True
+        )
+        assert got == crc_v
+
+    def test_empty_batch(self):
+        assert native.validate_batch([], TRACE_KINDS_MASK, MAX_PROTO) == []
+
+    def test_verdicts_match_python_peek(self):
+        """Native peek-grade accept/reject set == protocol.peek's, frame by
+        frame — the contract that lets drains swap implementations."""
+        frames, _, _ = _matrix()
+        got = native.validate_batch(frames, TRACE_KINDS_MASK, MAX_PROTO)
+        for frame, verdict in zip(frames, got):
+            try:
+                peek(frame)
+                py_ok = True
+            except ValueError:
+                py_ok = False
+            assert (verdict == OK) == py_ok, (frame, verdict)
+
+    def test_crc_verdicts_match_python_decode(self):
+        """CRC-grade accept set == full Python decode's (structural+crc;
+        decompress/unpack still run in Python on both paths)."""
+        frames, _, _ = _matrix()
+        got = native.validate_batch(
+            frames, TRACE_KINDS_MASK, MAX_PROTO, check_crc=True
+        )
+        for frame, verdict in zip(frames, got):
+            try:
+                decode(frame)
+                py_ok = True
+            except (ValueError, zlib.error, struct.error):
+                py_ok = False
+            assert (verdict == OK) == py_ok, (frame, verdict)
+
+    def test_big_batch_mixed(self):
+        """Interleave good and bad frames: the flattened-parts cursor must
+        stay aligned across frames the wrapper does not flatten."""
+        good = _good({"i": 7})
+        frames, out = [], []
+        for i in range(200):
+            if i % 5 == 2:
+                frames.append([])  # not flattened by the binding
+                out.append(BAD_PARTS)
+            elif i % 5 == 4:
+                frames.append([bytes([99]), good[1]])
+                out.append(BAD_PROTO)
+            else:
+                frames.append(good)
+                out.append(OK)
+        got = native.validate_batch(
+            frames, TRACE_KINDS_MASK, MAX_PROTO, check_crc=True
+        )
+        assert got == out
+
+
+@needs_native
+def test_crc32_matches_zlib():
+    for data in (b"", b"a", b"hello world" * 991, bytes(range(256)) * 33):
+        assert native.crc32(data) == zlib.crc32(data)
+        seed = zlib.crc32(b"seed")
+        assert native.crc32(data, seed) == zlib.crc32(data, seed)
+
+
+# ------------------------------------------- batch drains: native vs python
+class TestValidateHelpers:
+    """transport._validate_raw/_validate_traced — the functions behind
+    Sub.drain_raw/drain_traced — must agree between the native batch path
+    and the per-frame Python fallback."""
+
+    def _frames(self):
+        frames, _, crc_v = _matrix()
+        return frames, crc_v
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_validate_raw(self, use_native):
+        if use_native and not native.available():
+            pytest.skip("native codec not built")
+        frames, peek_v = _matrix()[0], _matrix()[1]
+        got, rejected = transport._validate_raw(frames, use_native)
+        keep = [i for i, v in enumerate(peek_v) if v == OK]
+        assert rejected == len(frames) - len(keep)
+        assert [parts for _, parts in got] == [frames[i] for i in keep]
+        for (proto, parts), i in zip(got, keep):
+            assert proto == Protocol(frames[i][0][0])
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_validate_traced(self, use_native):
+        if use_native and not native.available():
+            pytest.skip("native codec not built")
+        frames, crc_v = self._frames()
+        got, rejected = transport._validate_traced(frames, use_native)
+        keep = [i for i, v in enumerate(crc_v) if v == OK]
+        assert rejected == len(frames) - len(keep)
+        assert len(got) == len(keep)
+        for (proto, payload, trailer), i in zip(got, keep):
+            ref_proto, ref_payload = decode(frames[i])
+            assert proto == ref_proto
+            assert trailer == (frames[i][2] if len(frames[i]) == 3 else None)
+            np.testing.assert_equal(payload, ref_payload)
+
+    @needs_native
+    def test_paths_agree_on_random_garbage(self):
+        rng = np.random.default_rng(8)
+        frames = []
+        for _ in range(64):
+            n = int(rng.integers(1, 4))
+            frames.append(
+                [bytes(rng.integers(0, 256, int(rng.integers(1, 64)),
+                                    dtype=np.uint8)) for _ in range(n)]
+            )
+        nat = transport._validate_traced(frames, True)
+        py = transport._validate_traced(frames, False)
+        assert nat[1] == py[1]
+        assert len(nat[0]) == len(py[0])

@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-sample-n", type=int, default=None,
                    help="sample every Nth worker tick into the fleet trace "
                    "(result_dir/fleet_trace.json); 0/unset = off")
+    p.add_argument("--transport", choices=["tcp", "shm", "auto"],
+                   default=None,
+                   help="data-hop fabric for the rollout/stat fan-in: 'shm' "
+                   "routes same-host manager->storage and learner->storage "
+                   "hops through shared-memory rings (no sockets), 'auto' "
+                   "picks shm only when the peer address is loopback "
+                   "(default: tcp)")
     p.add_argument("--chaos-spec", default=None,
                    help="deterministic fault plan, e.g. "
                    "'kill:worker-0-1@t+3s,corrupt:rollout@p=0.01,"
@@ -89,6 +96,8 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["telemetry_port"] = args.telemetry_port
     if args.trace_sample_n is not None:
         overrides["trace_sample_n"] = args.trace_sample_n
+    if args.transport is not None:
+        overrides["transport"] = args.transport
     if args.chaos_spec is not None:
         overrides["chaos_spec"] = args.chaos_spec
     if args.chaos_seed is not None:

@@ -273,6 +273,17 @@ class Config:
     # dicts (split_rollout_batch + per-step push). Same assembled windows
     # bit-for-bit either way (tests/test_push_tick_equivalence.py).
     relay_mode: str = "raw"
+    # Data-hop fabric for the rollout/stat/telemetry fan-in (manager ->
+    # storage, learner/supervisor -> storage). "tcp": ZMQ PUB/SUB loopback
+    # or DCN everywhere (the default — remote-safe, zero shared state).
+    # "shm": producers write frames into named shared-memory SPSC rings and
+    # the consumer fans them in (transport.ShmPub/FanInSub) — same-host
+    # hops never touch a socket; the consumer's TCP SUB stays bound so
+    # remote producers in a mixed fleet still land. "auto": shm exactly
+    # when the hop's peer address is loopback (MachinesConfig), TCP
+    # otherwise. The model broadcast (fan-OUT to remote workers) always
+    # stays TCP.
+    transport: str = "tcp"
     # Acting placement (SEED RL / Podracer-Sebulba): "local" — each worker
     # runs its own jitted policy forward on CPU (reference semantics);
     # "remote" — workers ship observations to the centralized inference
@@ -426,6 +437,7 @@ class Config:
             )
         assert self.act_mode in ("local", "remote"), self.act_mode
         assert self.relay_mode in ("raw", "decode"), self.relay_mode
+        assert self.transport in ("tcp", "shm", "auto"), self.transport
         assert self.inference_batch >= 1, self.inference_batch
         assert self.inference_flush_us >= 0, self.inference_flush_us
         assert self.inference_timeout_ms > 0, self.inference_timeout_ms

@@ -57,7 +57,7 @@ from tpu_rl.runtime.mailbox import (
 )
 from tpu_rl.runtime.manager import STAT_WINDOW
 from tpu_rl.runtime.protocol import Protocol
-from tpu_rl.runtime.transport import MODEL_HWM, Pub
+from tpu_rl.runtime.transport import MODEL_HWM, Pub, make_data_pub
 from tpu_rl.utils.metrics import LearnerLogger, make_writer
 from tpu_rl.utils.timer import ExecutionTimer
 
@@ -334,7 +334,12 @@ class LearnerService:
             from tpu_rl.obs import MetricsRegistry
 
             telem_reg = MetricsRegistry(role="learner")
-            telem_pub = Pub("127.0.0.1", self.stat_port, bind=False)
+            # Storage telemetry hop: loopback by construction (learner and
+            # storage share the host), so transport="shm"/"auto" routes it
+            # through the shm channel instead of a TCP loopback socket.
+            telem_pub = make_data_pub(
+                cfg, "127.0.0.1", self.stat_port, bind=False
+            )
         # Span tracing: ring buffer over the batch timeline (assemble ->
         # queue-wait -> H2D -> train_step -> broadcast), dumped as Chrome
         # trace-event JSON at result_dir/trace.json on every loss-log flush.

@@ -32,7 +32,7 @@ from collections import deque
 
 from tpu_rl.config import Config
 from tpu_rl.runtime.protocol import Protocol, decode, encode, unpack_trace
-from tpu_rl.runtime.transport import Pub, Sub
+from tpu_rl.runtime.transport import Pub, Sub, make_data_pub
 
 RELAY_QUEUE_MAX = 1024  # reference manager.py:45-47
 STAT_WINDOW = 50  # reference manager.py:19,62-79
@@ -94,7 +94,12 @@ class Manager:
 
             chaos = maybe_transport_chaos(self.cfg, "manager")
         sub = self._sub = Sub("*", self.worker_port, bind=True, chaos=chaos)
-        pub = Pub(*self.learner_addr, bind=False, chaos=chaos)
+        # Storage hop: shm ring when Config.transport selects it for the
+        # learner address (same host), else the TCP PUB — same chaos shim,
+        # same send_raw surface either way.
+        pub = make_data_pub(
+            self.cfg, *self.learner_addr, bind=False, chaos=chaos
+        )
         recv = sub.recv_raw if self.raw else sub.recv_traced
 
         # Telemetry (tpu_rl.obs): the relay's own health snapshot, emitted
@@ -158,6 +163,16 @@ class Manager:
                         sub.n_rejected + self.n_stat_rejected
                     )
                     registry.gauge("manager-queue-depth").set(len(self.queue))
+                    if hasattr(pub, "n_dropped_full"):
+                        # Shm-channel shedding (ring full / no consumer
+                        # bound yet) — the fabric's analogue of PUB HWM
+                        # drops, surfaced on the same dashboards.
+                        registry.counter("shm-dropped-full").set_total(
+                            pub.n_dropped_full
+                        )
+                        registry.counter("shm-dropped-no-peer").set_total(
+                            pub.n_dropped_no_peer
+                        )
                     if chaos is not None:
                         registry.counter(
                             "chaos-corrupted-frames"

@@ -15,6 +15,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import sys
 import tempfile
 import threading
 
@@ -23,6 +24,33 @@ SRC = os.path.join(_REPO, "native", "codec.cpp")
 _BUILD_DIR = os.path.join(_REPO, "native", "build")
 
 _lock = threading.Lock()
+
+# Escape hatch + A/B switch: TPU_RL_NATIVE=0 disables the native codec
+# entirely (pure-Python zlib/LZ4 fallback everywhere). CI runs the relay and
+# protocol suites under both values so the fallback path can't rot.
+_DISABLED = os.environ.get("TPU_RL_NATIVE", "1") == "0"
+
+# The exact command _build() runs; surfaced in the one-time fallback warning
+# so an operator can reproduce the failure by hand.
+BUILD_CMD = "g++ -O3 -shared -fPIC -std=c++17 -o <out.so> " + SRC
+
+_warned_fallback = False
+
+
+def _warn_fallback(reason: str) -> None:
+    """Warn ONCE that the native codec is unavailable, naming the exact
+    compile command. The previous behavior — silently falling back to zlib —
+    hid both missing toolchains and stale-binary rebuild failures, so a fleet
+    could quietly run the slow path for weeks."""
+    global _warned_fallback
+    if _warned_fallback or _DISABLED:
+        return
+    _warned_fallback = True
+    print(
+        f"tpu_rl.native: falling back to pure-Python codec ({reason}); "
+        f"to build the native library run: {BUILD_CMD}",
+        file=sys.stderr,
+    )
 
 
 def _build() -> str | None:
@@ -33,6 +61,7 @@ def _build() -> str | None:
     a staleness guard, not tamper-proofing — build/ must stay writable only
     by the deploy user, and is untracked/.gitignored."""
     if not os.path.exists(SRC):
+        _warn_fallback(f"source missing: {SRC}")
         return None
     with open(SRC, "rb") as f:
         src_hash = hashlib.sha256(f.read()).hexdigest()[:16]
@@ -61,7 +90,16 @@ def _build() -> str | None:
         )
         os.replace(tmp, so)
         return so
-    except (subprocess.SubprocessError, OSError):
+    except subprocess.CalledProcessError as e:
+        stderr = (e.stderr or b"").decode(errors="replace").strip()
+        _warn_fallback(f"compile failed: {stderr.splitlines()[-1] if stderr else e}")
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    except (subprocess.SubprocessError, OSError) as e:
+        _warn_fallback(f"build failed: {type(e).__name__}: {e}")
         try:
             os.unlink(tmp)
         except OSError:
@@ -70,12 +108,15 @@ def _build() -> str | None:
 
 
 def _load() -> ctypes.CDLL | None:
+    if _DISABLED:
+        return None
     path = _build()
     if path is None:
         return None
     try:
         lib = ctypes.CDLL(path)
-    except OSError:
+    except OSError as e:
+        _warn_fallback(f"dlopen failed: {e}")
         return None
     i64, u32, buf = ctypes.c_int64, ctypes.c_uint32, ctypes.c_char_p
     lib.tpurl_compress_bound.restype = i64
@@ -86,6 +127,20 @@ def _load() -> ctypes.CDLL | None:
     lib.tpurl_decompress.argtypes = [buf, i64, ctypes.c_void_p, i64]
     lib.tpurl_crc32.restype = u32
     lib.tpurl_crc32.argtypes = [buf, i64, u32]
+    pp = ctypes.POINTER(ctypes.c_char_p)
+    batch_args = [
+        pp,                               # parts (flattened pointers)
+        ctypes.POINTER(i64),              # lens
+        ctypes.POINTER(ctypes.c_int32),   # nparts
+        i64,                              # n_frames
+        u32,                              # trace_kinds bitmask
+        ctypes.c_uint8,                   # max_proto
+        ctypes.POINTER(ctypes.c_uint8),   # out verdicts
+    ]
+    lib.tpurl_validate_batch.restype = i64
+    lib.tpurl_validate_batch.argtypes = batch_args
+    lib.tpurl_validate_batch_crc.restype = i64
+    lib.tpurl_validate_batch_crc.argtypes = batch_args
     return lib
 
 
@@ -121,3 +176,46 @@ def decompress(data: bytes, raw_size: int) -> bytes:
 def crc32(data: bytes, seed: int = 0) -> int:
     assert LIB is not None
     return int(LIB.tpurl_crc32(data, len(data), seed))
+
+
+def validate_batch(
+    frames: list[list[bytes]],
+    trace_kinds_mask: int,
+    max_proto: int,
+    check_crc: bool = False,
+) -> list[int]:
+    """Validate N multipart frames in ONE native call (GIL released for the
+    whole batch). ``frames`` is a list of part-lists as drained off a Sub;
+    returns one verdict per frame, 0 = valid (see Verdict in codec.cpp).
+    Frames whose part count exceeds the native cap (16) are rejected without
+    entering the library. With ``check_crc`` the body crc32 is verified too —
+    the storage-edge variant; without it this is relay-grade ``peek``."""
+    assert LIB is not None
+    n = len(frames)
+    if n == 0:
+        return []
+    flat: list[bytes] = []
+    nparts = (ctypes.c_int32 * n)()
+    for i, parts in enumerate(frames):
+        nparts[i] = len(parts)
+        if 0 < len(parts) <= 16:
+            flat.extend(parts)
+    total = len(flat)
+    # c_char_p arrays alias the bytes objects' buffers directly (no copy);
+    # `flat` keeps them alive across the call.
+    ptrs = (ctypes.c_char_p * total)(*flat) if total else (ctypes.c_char_p * 1)()
+    lens = (ctypes.c_int64 * max(total, 1))(*[len(p) for p in flat])
+    out = (ctypes.c_uint8 * n)()
+    fn = LIB.tpurl_validate_batch_crc if check_crc else LIB.tpurl_validate_batch
+    rc = fn(
+        ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_char_p)),
+        lens,
+        nparts,
+        n,
+        trace_kinds_mask & 0xFFFFFFFF,
+        max_proto & 0xFF,
+        out,
+    )
+    if rc < 0:
+        raise RuntimeError(f"native validate_batch failed: {rc}")
+    return list(out)

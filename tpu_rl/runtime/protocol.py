@@ -313,6 +313,14 @@ _TRAILER = struct.Struct("<HBxiIQq")
 # and is rejected into the receiver's ``n_rejected`` path.
 TRACE_KINDS = frozenset({Protocol.Rollout, Protocol.RolloutBatch})
 
+# Derived forms handed to the native batch validator (native/codec.cpp) so
+# the enum above stays the single source of truth: a bitmask over protocol
+# bytes allowed to carry a trailer, and the highest known protocol byte.
+TRACE_KINDS_MASK = 0
+for _k in TRACE_KINDS:
+    TRACE_KINDS_MASK |= 1 << int(_k)
+MAX_PROTO = max(int(_p) for _p in Protocol)
+
 
 def make_trace_id(wid: int, seq: int) -> int:
     """Deterministic fleet-unique trace id for a sampled tick: the origin
@@ -409,27 +417,36 @@ def peek(parts: list[bytes]) -> Protocol:
     return proto
 
 
-def decode(parts: list[bytes]) -> tuple[Protocol, Any]:
+def decode(parts: list[bytes], validated: bool = False) -> tuple[Protocol, Any]:
     """Inverse of :func:`encode` (reference ``decode``,
     ``utils/utils.py:248-249``). Raises ValueError on malformed frames —
     including a trace trailer on a kind that doesn't allow one (the trailer
     itself is otherwise ignored here; lineage consumers read it via
-    ``Sub.recv_traced``)."""
+    ``Sub.recv_traced``).
+
+    ``validated=True`` skips the structural checks AND the body CRC pass:
+    the caller already ran them, e.g. via the native batch validator's
+    crc variant (``native.validate_batch(check_crc=True)``) over a whole
+    drained deque — re-hashing every body here would pay the batch's
+    dominant cost a second time. Decompress + schema unpack still run."""
     if len(parts) not in (2, 3) or len(parts[0]) != 1:
         raise ValueError(f"malformed multipart message: {len(parts)} parts")
     proto = Protocol(parts[0][0])
-    if len(parts) == 3:
+    if not validated and len(parts) == 3:
         _check_trailer(proto, parts)
     frame = parts[1]
     if len(frame) < _HEADER.size:
         raise ValueError("short frame")
     magic, version, codec, raw_size, crc = _HEADER.unpack_from(frame)
-    if magic != _MAGIC or version != _VERSION:
-        raise ValueError(f"bad frame magic/version {magic:#x}/{version}")
-    if raw_size > _MAX_RAW:
-        raise ValueError(f"declared raw size {raw_size} exceeds cap {_MAX_RAW}")
+    if not validated:
+        if magic != _MAGIC or version != _VERSION:
+            raise ValueError(f"bad frame magic/version {magic:#x}/{version}")
+        if raw_size > _MAX_RAW:
+            raise ValueError(
+                f"declared raw size {raw_size} exceeds cap {_MAX_RAW}"
+            )
     body = frame[_HEADER.size :]
-    if _crc(body) & 0xFFFFFFFF != crc:
+    if not validated and _crc(body) & 0xFFFFFFFF != crc:
         raise ValueError("frame crc mismatch")
     if codec == Codec.RAW:
         raw = body

@@ -307,11 +307,11 @@ class Supervisor:
             # import time.
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
             from tpu_rl.runtime.protocol import Protocol
-            from tpu_rl.runtime.transport import Pub
+            from tpu_rl.runtime.transport import make_data_pub
 
             cfg, ip, port = self._telem_cfg
             reg = MetricsRegistry(role="supervisor")
-            pub = Pub(ip, port, bind=False)
+            pub = make_data_pub(cfg, ip, port, bind=False)
             emitter = PeriodicSnapshot(
                 reg,
                 lambda snap: pub.send(Protocol.Telemetry, snap),

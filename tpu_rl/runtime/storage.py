@@ -38,7 +38,7 @@ from tpu_rl.runtime.mailbox import (
     STAT_SLOTS,
 )
 from tpu_rl.runtime.protocol import Protocol, unpack_trace
-from tpu_rl.runtime.transport import Sub
+from tpu_rl.runtime.transport import Sub, make_data_sub
 
 # Slot layout lives in tpu_rl.runtime.mailbox (shared with the learner's
 # reader); STAT_SLOTS is re-exported here for existing importers.
@@ -97,8 +97,12 @@ class LearnerStorage:
         layout = BatchLayout.from_config(cfg)
         assembler = RolloutAssembler(layout, lag_sec=cfg.rollout_lag_sec)
         store = make_store(cfg, layout, handles=self.handles)
-        sub = self._sub = Sub(
-            "*", self.learner_port, bind=True, chaos=self._chaos
+        # Fan-in edge: a FanInSub (shm rings + the TCP SUB) when
+        # Config.transport enables the shm channel, else the plain TCP SUB.
+        # Either way the ingest loop below sees the same recv_traced/
+        # drain_traced surface and the same n_rejected accounting.
+        sub = self._sub = make_data_sub(
+            cfg, "*", self.learner_port, bind=True, chaos=self._chaos
         )
         self._setup_trace(assembler)
         self._setup_telemetry()
