@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: lint format-check test native-build protocol-matrix relay-smoke \
-	obs-smoke trace-smoke chaos-smoke colocated-smoke ci
+	obs-smoke trace-smoke chaos-smoke colocated-smoke resume-smoke ci
 
 lint:
 	ruff check .
@@ -72,5 +72,13 @@ chaos-smoke:
 colocated-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/colocated_smoke.py
 
+# Resume smoke: SIGKILL the learner and storage after the first committed
+# checkpoint and assert supervised respawn, monotonic resume from the newest
+# committed index at a bumped run epoch, stale-epoch frames fenced, workers
+# re-registered, fault accounting intact, and a planted torn save never
+# restored.
+resume-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/resume_smoke.py
+
 ci: lint test protocol-matrix relay-smoke obs-smoke trace-smoke chaos-smoke \
-	colocated-smoke
+	colocated-smoke resume-smoke

@@ -1,0 +1,278 @@
+"""Resume smoke: SIGKILL the learner AND the storage mid-run and assert the
+fleet survives with its full run state intact — the ``make resume-smoke``
+CI gate for the durability plane (checkpoint atomicity, full-run resume,
+run-epoch fencing, membership).
+
+Sequence (driven from this harness so the kills land deterministically
+relative to checkpoint progress, unlike a wall-clock chaos spec):
+
+1. boot the smallest real cluster with a TORN checkpoint fixture planted in
+   the model dir (an orbax-shaped dir with no COMMITTED marker — a crash
+   mid-save) and probabilistic rollout corruption from the chaos plane;
+2. wait for the first COMMITTED checkpoint, then SIGKILL storage and the
+   learner back-to-back;
+3. assert the supervisor respawned both, the learner resumed from the
+   newest committed index at a bumped run epoch (``learner_resume.jsonl``),
+   and the run completed cleanly with the final update index past the
+   resume point (monotonic resume, never a restart from 0);
+4. assert the respawned storage fenced stale-epoch frames from the
+   pre-crash incarnation (counted, separate from corruption rejects), that
+   every worker re-registered in the membership table, and that chaos
+   fault accounting still balances exactly (injected == rejected);
+5. assert the torn fixture was never restored and is swept from disk.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/resume_smoke.py \
+      [--updates 24] [--base-port 28700]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TORN_IDX = 999_999  # planted torn dir: newer than any real index
+
+
+def _counter(source: dict, name: str) -> float:
+    return sum(
+        v for n, _labels, v in source.get("counters", ()) if n == name
+    )
+
+
+def _role_total(tele: dict, role: str, name: str) -> float:
+    return sum(
+        _counter(s, name) for s in tele["sources"] if s.get("role") == role
+    )
+
+
+def _gauge_max(tele: dict, role: str, name: str) -> float:
+    vals = [
+        v
+        for s in tele["sources"]
+        if s.get("role") == role
+        for n, _labels, v in s.get("gauges", ())
+        if n == name
+    ]
+    return max(vals) if vals else float("-inf")
+
+
+def _child(sup, name: str):
+    return next(c for c in sup.children if c.name == name)
+
+
+def _wait(pred, timeout: float, poll: float = 0.2) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return pred()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--updates", type=int, default=24)
+    p.add_argument("--base-port", type=int, default=28700)
+    p.add_argument("--timeout", type=float, default=300.0)
+    args = p.parse_args()
+
+    from tests.conftest import small_config  # the CI-sized Config recipe
+    from tpu_rl.checkpoint import latest_committed
+    from tpu_rl.config import MachinesConfig, WorkerMachine
+    from tpu_rl.runtime.runner import local_cluster
+
+    run_dir = tempfile.mkdtemp(prefix="resume_smoke_")
+    model_dir = os.path.join(run_dir, "models")
+    # Torn-save fixture: an uncommitted dir with a HIGHER index than the run
+    # will ever reach. If the marker protocol leaks anywhere, the worker
+    # warm-start or the learner resume would pick it and crash/corrupt.
+    torn = os.path.join(model_dir, f"PPO_{TORN_IDX}")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "checkpoint"), "w") as f:
+        f.write("torn mid-write by a previous incarnation")
+
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        # Pace rollout generation so the run is data-bound: the kills land
+        # mid-run with headroom instead of racing a millisecond-fast loop.
+        worker_step_sleep=0.005,
+        learner_device="cpu",
+        rollout_lag_sec=30.0,
+        time_horizon=100,
+        loss_log_interval=4,
+        result_dir=run_dir,
+        model_dir=model_dir,
+        model_save_interval=2,
+        ckpt_keep=3,
+        telemetry_interval_s=0.5,
+        telemetry_stale_s=120.0,
+        supervise_poll_s=0.25,
+        chaos_spec="corrupt:rollout@p=0.03",
+        chaos_seed=11,
+    )
+    machines = MachinesConfig(
+        learner_ip="127.0.0.1",
+        learner_port=args.base_port,
+        workers=[WorkerMachine(
+            num_p=2, manager_ip="127.0.0.1", ip="127.0.0.1",
+            port=args.base_port + 5,
+        )],
+    )
+    print(f"[resume-smoke] cluster up; run_dir={run_dir}", flush=True)
+    sup = local_cluster(cfg, machines, max_updates=args.updates)
+    failures: list[str] = []
+    resume_path = os.path.join(run_dir, "learner_resume.jsonl")
+    loop_thread = threading.Thread(target=sup.loop, daemon=True)
+    loop_thread.start()
+    try:
+        # ---- phase 1: first committed checkpoint, then the double kill ----
+        if not _wait(
+            lambda: latest_committed(model_dir, "PPO") is not None,
+            args.timeout * 0.6,
+        ):
+            failures.append("no committed checkpoint appeared before kill")
+        elif sup.stop_event.is_set():
+            failures.append("fleet finished before the mid-run kill landed")
+        else:
+            committed_idx = latest_committed(model_dir, "PPO")[0]
+            print(
+                f"[resume-smoke] first commit at idx {committed_idx}; "
+                "SIGKILL storage + learner", flush=True,
+            )
+            # Storage first, learner immediately after: both die inside one
+            # supervision window, so the respawned storage (fence restored
+            # from the cross-respawn mailbox) is live while the workers are
+            # still acting on the pre-crash epoch — the stale frames it
+            # fences are the ones this smoke asserts on.
+            for name in ("storage", "learner"):
+                os.kill(_child(sup, name).proc.pid, signal.SIGKILL)
+            if not _wait(
+                lambda: _child(sup, "storage").restarts >= 1
+                and _child(sup, "learner").restarts >= 1,
+                60.0,
+            ):
+                failures.append("supervisor did not respawn both children")
+            if not _wait(lambda: os.path.exists(resume_path), 120.0):
+                failures.append(
+                    "respawned learner wrote no resume record "
+                    "(learner_resume.jsonl missing)"
+                )
+        # ---- phase 2: the resumed run completes ----
+        if not sup.stop_event.wait(args.timeout):
+            failures.append(f"fleet did not complete within {args.timeout:.0f}s")
+        loop_thread.join(10.0)
+        learner = _child(sup, "learner")
+        learner.proc.join(30.0)
+        if learner.proc.is_alive() or learner.proc.exitcode != 0:
+            failures.append(
+                f"resumed learner did not exit cleanly "
+                f"(alive={learner.proc.is_alive()}, "
+                f"exitcode={learner.proc.exitcode})"
+            )
+    finally:
+        sup.stop()
+
+    # ---- resume audit: monotonic continuation, epoch bump ----
+    resumed_idx = resumed_epoch = None
+    try:
+        with open(resume_path) as f:
+            rec = [json.loads(line) for line in f if line.strip()][-1]
+        resumed_idx, resumed_epoch = int(rec["idx"]), int(rec["epoch"])
+    except (OSError, ValueError, IndexError, KeyError) as e:
+        failures.append(f"resume record unreadable: {type(e).__name__}: {e}")
+    if resumed_idx is not None:
+        if resumed_idx < 1 or resumed_idx >= TORN_IDX:
+            failures.append(
+                f"resumed from idx {resumed_idx} — expected a real committed "
+                f"index (>= 1, never the torn fixture {TORN_IDX})"
+            )
+        if resumed_epoch is None or resumed_epoch < 1:
+            failures.append(
+                f"resume did not bump the run epoch (epoch={resumed_epoch})"
+            )
+        print(
+            f"[resume-smoke] resumed at idx {resumed_idx}, "
+            f"run epoch {resumed_epoch}", flush=True,
+        )
+    if os.path.isdir(torn):
+        failures.append("torn checkpoint fixture survived the learner sweep")
+
+    tele_path = os.path.join(run_dir, "telemetry.json")
+    try:
+        tele = json.loads(open(tele_path).read())
+    except (OSError, ValueError) as e:
+        failures.append(f"telemetry.json invalid: {type(e).__name__}: {e}")
+        tele = {"sources": []}
+
+    final_idx = _gauge_max(tele, "learner", "learner-update-index")
+    if resumed_idx is not None and final_idx <= resumed_idx:
+        failures.append(
+            f"update index did not advance past the resume point "
+            f"({final_idx} <= {resumed_idx}) — the run restarted, not resumed"
+        )
+    epoch_seen = _gauge_max(tele, "learner", "learner-run-epoch")
+    if epoch_seen < 1:
+        failures.append(
+            f"learner-run-epoch={epoch_seen} in telemetry, expected >= 1"
+        )
+    stale = _role_total(tele, "storage", "storage-stale-epoch-frames")
+    if stale < 1:
+        failures.append(
+            "storage fenced zero stale-epoch frames — the pre-crash "
+            "incarnation's rollouts were admitted into the resumed run"
+        )
+    else:
+        print(f"[resume-smoke] stale frames fenced: {stale:.0f}", flush=True)
+    joined = _role_total(tele, "storage", "storage-members-joined")
+    if joined < 2:
+        failures.append(
+            f"storage-members-joined={joined:.0f} after respawn, expected "
+            "both workers to re-register"
+        )
+    pushes = _role_total(tele, "learner", "learner-join-pushes")
+    if pushes < 1:
+        failures.append(
+            f"learner-join-pushes={pushes:.0f}, expected >= 1 (the join "
+            "flag never reached the learner)"
+        )
+    # Fault accounting parity must survive the respawns: the corrupting shim
+    # and the CRC reject both live in the storage process, so they reset
+    # together and the fleet-wide totals still balance exactly. Stale-epoch
+    # drops are counted separately and must NOT leak into this ledger.
+    corrupted = _role_total(tele, "storage", "chaos-corrupted-frames")
+    rejected = sum(
+        _role_total(tele, role, f"{role}-rejected-frames")
+        for role in ("worker", "manager", "storage")
+    )
+    if corrupted != rejected:
+        failures.append(
+            f"fault accounting mismatch across respawn: injected "
+            f"{corrupted:.0f} corruptions but the fleet rejected "
+            f"{rejected:.0f} frames"
+        )
+    else:
+        print(
+            f"[resume-smoke] fault accounting: {corrupted:.0f} injected == "
+            f"{rejected:.0f} rejected", flush=True,
+        )
+
+    if failures:
+        for f in failures:
+            print(f"[resume-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[resume-smoke] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -303,6 +303,9 @@ ROLLOUT_KEYS = (
     # telemetry echo (tpu_rl.obs): worker id + policy version ride every
     # tick in BOTH acting modes, so layout parity must cover them too
     "wid", "ver",
+    # run-epoch echo (durability plane): storage fences out frames acted
+    # under a pre-crash learner incarnation; -1 until a broadcast arrives
+    "epoch",
 )
 
 
@@ -453,7 +456,7 @@ class TestStatPlumbing:
         assert pub.sent[0][1]["model_loads"] == 0
 
     def test_storage_mailbox_health_slots(self):
-        assert STAT_SLOTS == 7
+        assert STAT_SLOTS == 9
         cfg = small_config()
         sa = np.zeros(STAT_SLOTS, np.float32)
         storage = LearnerStorage(cfg, handles=None, learner_port=0,
@@ -465,6 +468,9 @@ class TestStatPlumbing:
         assert sa[0] == 50 and sa[1] == 7.5 and sa[2] == 1.0
         assert sa[3] == 3.0 and sa[4] == 12.0
         assert sa[5] == 2.0 and sa[6] == 4096.0
+        # the membership/epoch slots are NOT stat relay state: a stat
+        # write must never clobber a pending join request or the fence
+        assert sa[7] == 0.0 and sa[8] == 0.0
 
     def test_storage_mailbox_tolerates_legacy_3_slot_array(self):
         cfg = small_config()

@@ -15,6 +15,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from tpu_rl.config import Config, MachinesConfig, default_result_dirs
@@ -46,6 +47,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-result-dir", action="store_true",
                    help="disable tensorboard/checkpoint output")
+    p.add_argument("--result-dir", default=None,
+                   help="fixed result dir (checkpoints land in "
+                   "<result-dir>/models). Run the same role twice with the "
+                   "same --result-dir and the learner resumes from the "
+                   "newest committed checkpoint instead of starting over "
+                   "(default: a fresh timestamped dir per run)")
+    p.add_argument("--model-save-interval", type=int, default=None,
+                   help="checkpoint every N learner updates")
+    p.add_argument("--ckpt-keep", type=int, default=None,
+                   help="committed checkpoints retained on disk (>= 1)")
+    p.add_argument("--ckpt-sync", action="store_true",
+                   help="blocking checkpoint saves on the update loop "
+                   "(default: async background writer; both are "
+                   "commit-atomic — this is the A/B baseline)")
+    p.add_argument("--resume-force", action="store_true",
+                   help="resume even if the checkpoint's config fingerprint "
+                   "(model/env structure) disagrees with the current config")
     p.add_argument("--telemetry-port", type=int, default=None,
                    help="serve Prometheus /metrics + /healthz from the "
                    "storage process on this port (0/unset = off)")
@@ -110,6 +128,21 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["supervise_poll_s"] = args.supervise_poll
     if args.max_restarts is not None:
         overrides["max_restarts"] = args.max_restarts
+    if args.result_dir is not None:
+        overrides["result_dir"] = args.result_dir
+        # A user-set model_dir (e.g. from --params) still wins; otherwise
+        # checkpoints live under the pinned result dir so a rerun with the
+        # same flag resumes from them.
+        if cfg.model_dir is None:
+            overrides["model_dir"] = os.path.join(args.result_dir, "models")
+    if args.model_save_interval is not None:
+        overrides["model_save_interval"] = args.model_save_interval
+    if args.ckpt_keep is not None:
+        overrides["ckpt_keep"] = args.ckpt_keep
+    if args.ckpt_sync:
+        overrides["ckpt_async"] = False
+    if args.resume_force:
+        overrides["resume_force"] = True
     if overrides:
         cfg = cfg.replace(**overrides)
     machines = (

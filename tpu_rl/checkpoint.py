@@ -1,48 +1,147 @@
-"""Checkpoint / resume via orbax.
+"""Crash-atomic, async checkpoint / resume via orbax.
 
 Capability parity with the reference's ``torch.save`` every
 ``model_save_interval`` updates + newest-file-wins resume
 (``/root/reference/agents/learner_module/ppo/learning.py:113-119``,
-``utils/utils.py:93-98``, ``main.py:128-146``), upgraded per SURVEY.md §5.4:
-the full train state is saved — params, optimizer state, and the update
-counter — so a resumed run continues instead of restarting its update index
-and re-warming its optimizer. Directory naming keeps the reference's
-``{algo}_{idx}`` convention so "newest index wins" is preserved.
+``utils/utils.py:93-98``, ``main.py:128-146``), upgraded twice over:
+
+**Atomicity.** The reference (and our first cut) could crash mid-write and
+leave a torn checkpoint that the newest-index scan would happily restore.
+Here a save is a two-phase commit: orbax writes the tree into its final
+``{model_dir}/{algo}_{idx}`` directory, and only after
+``wait_until_finished()`` is a ``COMMITTED`` marker file atomically placed
+*inside* that directory (tmp + ``os.replace``). Every read path — worker
+warm-start (:func:`restore_actor_params`), learner resume
+(:meth:`Checkpointer.restore_run`), GC — filters on the marker, so a torn
+save is simply invisible: readers fall back to the previous committed index.
+The marker doubles as the run-meta record (update idx, run epoch, learner
+PRNG key, config fingerprint), widening the payload from "train state" to
+"full run state" — a resumed run continues its RNG stream and update index
+instead of restarting them, and refuses to load a checkpoint produced by a
+structurally different config unless forced.
+
+**Asynchrony.** ``save()`` can hand the work to a background thread (the
+PR-1 ``AsyncPublisher`` recipe): the caller takes a device-side snapshot
+(``jnp.copy`` — donation-proof — plus ``copy_to_host_async``) and returns;
+the thread does the blocking D2H ``device_get``, the orbax write, the
+commit, and the GC. Saves are latest-wins: a newer snapshot replaces a
+queued-but-unstarted older one (counted in ``n_skipped``). Wall time per
+committed save is surfaced via :meth:`Checkpointer.drain_save_secs` so the
+learner can publish the sync-vs-async A/B as a telemetry timer.
+
+Directory naming keeps the reference's ``{algo}_{idx}`` convention so
+"newest index wins" is preserved.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
+import shutil
+import threading
+import time
 from typing import Any
 
 import jax
+import jax.numpy as jnp
+
+# Marker filename inside a committed checkpoint dir. Its presence is the
+# commit point; its content is the run-meta JSON. Orbax ignores foreign
+# files in the directory on restore (probed against orbax 0.7.0).
+COMMIT_MARKER = "COMMITTED"
+
+# Config fields that shape the train-state pytree or the meaning of its
+# numbers — the resume compatibility surface. Runtime knobs (ports,
+# supervision, telemetry, chaos, throttles) are deliberately excluded:
+# changing them must never strand a checkpoint.
+_FINGERPRINT_FIELDS = (
+    "env",
+    "algo",
+    "model",
+    "hidden_size",
+    "n_heads",
+    "n_layers",
+    "seq_len",
+    "attention_impl",
+    "obs_shape",
+    "action_space",
+    "is_continuous",
+    "compute_dtype",
+    "need_conv",
+    "height",
+    "width",
+    "is_gray",
+)
 
 
-def _ckpt_dirs(model_dir: str, algo: str) -> list[tuple[int, str]]:
+def resume_fingerprint(cfg) -> str:
+    """Stable hash of the structure-defining config subset. Stored in every
+    commit marker; checked on resume (``Config.resume_force`` overrides)."""
+    sub = {k: getattr(cfg, k) for k in _FINGERPRINT_FIELDS}
+    blob = json.dumps(sub, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def is_committed(path: str) -> bool:
+    return os.path.exists(os.path.join(path, COMMIT_MARKER))
+
+
+def read_meta(path: str) -> dict:
+    """Run-meta of a committed checkpoint dir; {} when absent/corrupt (a
+    truncated marker is treated as not-quite-committed metadata, but the
+    tree itself is orbax-complete by write ordering, so readers may still
+    use it with default meta)."""
+    try:
+        with open(os.path.join(path, COMMIT_MARKER)) as f:
+            meta = json.load(f)
+        return meta if isinstance(meta, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _ckpt_dirs(
+    model_dir: str, algo: str, committed_only: bool = True
+) -> list[tuple[int, str]]:
     """[(idx, path)] of existing checkpoints, sorted by idx (reference index
-    parser ``utils/utils.py:93-98``)."""
+    parser ``utils/utils.py:93-98``). By default only COMMITTED dirs are
+    visible — torn/in-flight saves do not exist as far as readers know."""
     if not os.path.isdir(model_dir):
         return []
     out = []
     pat = re.compile(re.escape(algo) + r"_(\d+)$")
     for name in os.listdir(model_dir):
         m = pat.match(name)
-        if m:
-            out.append((int(m.group(1)), os.path.join(model_dir, name)))
+        if not m:
+            continue
+        path = os.path.join(model_dir, name)
+        if committed_only and not is_committed(path):
+            continue
+        out.append((int(m.group(1)), path))
     return sorted(out)
 
 
+def latest_committed(model_dir: str, algo: str) -> tuple[int, str] | None:
+    """(idx, path) of the newest committed checkpoint, or None."""
+    found = _ckpt_dirs(os.path.abspath(model_dir), algo)
+    return found[-1] if found else None
+
+
 def restore_actor_params(model_dir: str, algo: str):
-    """Actor parameter tree of the NEWEST checkpoint, as host numpy arrays
-    wrapped ``{"actor": ...}`` (the worker acting contract), or None when no
-    checkpoint exists.
+    """Actor parameter tree of the NEWEST *committed* checkpoint, as host
+    numpy arrays wrapped ``{"actor": ...}`` (the worker acting contract), or
+    None when no committed checkpoint exists.
 
     This is the worker warm-start path: the reference loads the newest
     checkpoint into every worker at spawn (``/root/reference/main.py:247-252``
     via the newest-file scan ``:128-146``) so actors start from the trained
     policy instead of random init. Template-free raw restore: callers (the
     worker role) don't build a learner train state just to know its structure.
+
+    Falls back newest→oldest on restore failure: a spawning worker can lose
+    the race with the learner's GC (the dir it listed vanishes) — the next
+    older committed checkpoint is the correct answer, not a crash.
     """
     found = _ckpt_dirs(os.path.abspath(model_dir), algo)
     if not found:
@@ -50,57 +149,265 @@ def restore_actor_params(model_dir: str, algo: str):
     import orbax.checkpoint as ocp
 
     with ocp.PyTreeCheckpointer() as ckpt:
-        raw = ckpt.restore(found[-1][1])
-    # TrainState nests under "params"/"actor"; SACState keeps "actor_params".
-    params = raw.get("params")
-    actor = params.get("actor") if isinstance(params, dict) else None
-    if actor is None:
-        actor = raw.get("actor_params")
-    return {"actor": actor} if actor is not None else None
+        for _idx, path in reversed(found):
+            try:
+                raw = ckpt.restore(path)
+            except Exception:
+                continue  # lost a GC race or damaged tree: try the previous
+            # TrainState nests under "params"/"actor"; SACState keeps
+            # "actor_params".
+            params = raw.get("params")
+            actor = params.get("actor") if isinstance(params, dict) else None
+            if actor is None:
+                actor = raw.get("actor_params")
+            if actor is not None:
+                return {"actor": actor}
+    return None
+
+
+def _snapshot(state: Any) -> Any:
+    """Donation-proof device-side copy with D2H started in the background
+    (the AsyncPublisher recipe): the caller's buffers may be donated to the
+    next train step, so the background writer must own its own."""
+
+    def snap(x):
+        if isinstance(x, jax.Array):
+            y = jnp.copy(x)
+            try:
+                y.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # committed arrays on some backends; device_get covers it
+            return y
+        return x
+
+    return jax.tree_util.tree_map(snap, state)
 
 
 class Checkpointer:
-    def __init__(self, model_dir: str, algo: str, keep: int = 5):
+    """Single-writer checkpoint manager (lives in the learner process).
+
+    ``async_save=False`` (the default, and the direct-caller/test contract)
+    keeps ``save()`` blocking-but-atomic. The learner service passes
+    ``Config.ckpt_async`` to move the D2H + disk write off the update loop.
+    """
+
+    def __init__(
+        self,
+        model_dir: str,
+        algo: str,
+        keep: int = 5,
+        async_save: bool = False,
+    ):
         self.model_dir = os.path.abspath(model_dir)
         self.algo = algo
-        self.keep = keep
+        self.keep = max(1, int(keep))
+        self.async_save = bool(async_save)
         os.makedirs(self.model_dir, exist_ok=True)
+        self._clean_torn()
         import orbax.checkpoint as ocp
 
         self._ckpt = ocp.StandardCheckpointer()
+        # --- async machinery (idle unless async_save) ---
+        self._cond = threading.Condition()
+        self._queued: tuple[Any, int, dict] | None = None
+        self._inflight = False
+        self._stop = False
+        self._error: Exception | None = None
+        self._durations: list[float] = []
+        self._thread: threading.Thread | None = None
+        # --- introspection ---
+        self.n_saves = 0  # committed saves
+        self.n_skipped = 0  # latest-wins drops of queued-but-unstarted saves
+        self.last_save_secs = 0.0
 
-    def save(self, state: Any, idx: int) -> str:
-        """Blocking save of the full train-state pytree as
-        ``{model_dir}/{algo}_{idx}``."""
+    # ------------------------------------------------------------- lifecycle
+    def _clean_torn(self) -> None:
+        """Remove torn dirs left by a crash mid-save. Safe: this process is
+        the only writer (the supervisor guarantees the previous learner
+        incarnation is dead before respawn), and no reader ever sees an
+        uncommitted dir."""
+        for _idx, path in _ckpt_dirs(
+            self.model_dir, self.algo, committed_only=False
+        ):
+            if not is_committed(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="ckpt-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _raise_pending_error(self) -> None:
+        with self._cond:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError("async checkpoint save failed") from err
+
+    # ------------------------------------------------------------------ save
+    def save(self, state: Any, idx: int, meta: dict | None = None) -> str:
+        """Save the full train-state pytree as ``{model_dir}/{algo}_{idx}``
+        with run-meta ``meta`` committed alongside. Blocking when
+        ``async_save`` is off; otherwise snapshots device-side and returns
+        (an error from a previous background save re-raises here)."""
+        self._raise_pending_error()
         path = os.path.join(self.model_dir, f"{self.algo}_{idx}")
-        self._ckpt.save(path, jax.device_get(state), force=True)
-        self._ckpt.wait_until_finished()
-        self._gc()
+        meta = dict(meta or {})
+        if not self.async_save:
+            t0 = time.perf_counter()
+            self._write(jax.device_get(state), idx, meta)
+            self._record(time.perf_counter() - t0)
+            return path
+        snap = _snapshot(state)
+        self._ensure_thread()
+        with self._cond:
+            if self._queued is not None:
+                self.n_skipped += 1  # latest wins: newer snapshot replaces
+            self._queued = (snap, idx, meta)
+            self._cond.notify_all()
         return path
 
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._queued is None and not self._stop:
+                    self._cond.wait()
+                if self._queued is None:  # stop, nothing pending
+                    return
+                snap, idx, meta = self._queued
+                self._queued = None
+                self._inflight = True
+            t0 = time.perf_counter()
+            try:
+                self._write(jax.device_get(snap), idx, meta)
+                dur: float | None = time.perf_counter() - t0
+            except Exception as e:  # surfaced on the next save()/flush()
+                dur = None
+                with self._cond:
+                    self._error = e
+            with self._cond:
+                self._inflight = False
+                if dur is not None:
+                    self._record(dur)
+                self._cond.notify_all()
+
+    def _write(self, host_state: Any, idx: int, meta: dict) -> None:
+        """The two-phase commit: orbax tree write, then the atomic marker."""
+        path = os.path.join(self.model_dir, f"{self.algo}_{idx}")
+        self._ckpt.save(path, host_state, force=True)
+        self._ckpt.wait_until_finished()
+        meta.setdefault("idx", idx)
+        meta.setdefault("algo", self.algo)
+        meta.setdefault("saved_at", time.time())
+        tmp = os.path.join(path, f".{COMMIT_MARKER}.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, COMMIT_MARKER))
+        self._gc()
+
+    def _record(self, dur: float) -> None:
+        self.n_saves += 1
+        self.last_save_secs = dur
+        self._durations.append(dur)
+
+    # ----------------------------------------------------------- observation
+    @property
+    def pending(self) -> int:
+        """Saves accepted but not yet committed (0-2: one queued + one in
+        flight) — the ``learner-ckpt-pending`` gauge."""
+        with self._cond:
+            return (self._queued is not None) + self._inflight
+
+    def drain_save_secs(self) -> list[float]:
+        """Wall seconds of saves committed since the last drain — feeds the
+        ``learner-ckpt-time`` timer regardless of which thread did the
+        write."""
+        with self._cond:
+            out, self._durations = self._durations, []
+        return out
+
+    def flush(self, timeout: float | None = None) -> None:
+        """Block until every accepted save is committed (async mode)."""
+        with self._cond:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while self._queued is not None or self._inflight:
+                left = None if deadline is None else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    break
+                self._cond.wait(timeout=left)
+        self._raise_pending_error()
+
+    # --------------------------------------------------------------- restore
     def latest_idx(self) -> int | None:
         found = _ckpt_dirs(self.model_dir, self.algo)
         return found[-1][0] if found else None
 
     def restore_latest(self, template: Any) -> tuple[Any, int] | None:
-        """Newest-index-wins restore into the structure of ``template``.
-        Returns (state, idx) or None when no checkpoint exists."""
+        """Newest-*committed*-index-wins restore into the structure of
+        ``template``. Returns (state, idx) or None when no committed
+        checkpoint exists."""
+        out = self.restore_run(template)
+        return (out[0], out[1]) if out is not None else None
+
+    def restore_run(
+        self,
+        template: Any,
+        fingerprint: str | None = None,
+        force: bool = False,
+    ) -> tuple[Any, int, dict] | None:
+        """Full-run resume: (state, idx, meta) of the newest committed
+        checkpoint, or None. When ``fingerprint`` is given and the stored
+        one disagrees, refuses (RuntimeError) unless ``force`` — restoring
+        an optimizer/params tree produced by a structurally different
+        config is silent corruption, not resume."""
         found = _ckpt_dirs(self.model_dir, self.algo)
         if not found:
             return None
         idx, path = found[-1]
+        meta = read_meta(path)
+        stored = meta.get("fingerprint")
+        if fingerprint is not None and stored is not None and stored != fingerprint:
+            if not force:
+                raise RuntimeError(
+                    f"checkpoint {path} was written by a different config "
+                    f"(fingerprint {stored} != {fingerprint}); pass "
+                    "--resume-force to override"
+                )
+            print(
+                f"[checkpoint] WARNING: fingerprint mismatch ({stored} != "
+                f"{fingerprint}) overridden by resume_force",
+                flush=True,
+            )
         restored = self._ckpt.restore(
             path, jax.tree_util.tree_map(lambda x: x, template)
         )
-        return restored, idx
+        return restored, idx, meta
 
+    # -------------------------------------------------------------------- gc
     def _gc(self) -> None:
-        """Bound disk usage (the reference keeps every checkpoint forever)."""
+        """Bound disk usage (the reference keeps every checkpoint forever).
+        Operates on COMMITTED dirs only: an uncommitted dir is either a
+        concurrent in-flight save (deleting it would corrupt the write) or
+        torn debris already invisible to readers (cleaned at next init) —
+        and the newest committed checkpoint is never removed (keep >= 1),
+        so a restore that just listed it cannot have it deleted mid-read
+        except for dirs that stopped being newest, which the readers'
+        newest→oldest retry loop absorbs."""
         found = _ckpt_dirs(self.model_dir, self.algo)
         for _idx, path in found[: -self.keep]:
-            import shutil
-
             shutil.rmtree(path, ignore_errors=True)
 
     def close(self) -> None:
+        """Flush pending saves, stop the writer thread, release orbax."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            # The writer drains the queued save before honoring stop.
+            self._thread.join(timeout=120.0)
+            self._thread = None
         self._ckpt.close()
+        self._raise_pending_error()

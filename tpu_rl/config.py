@@ -153,6 +153,17 @@ class Config:
     # logging / checkpoints
     loss_log_interval: int = 50
     model_save_interval: int = 100
+    # Committed checkpoints retained on disk (newest-index wins; GC removes
+    # older COMMITTED dirs only — see tpu_rl/checkpoint.py).
+    ckpt_keep: int = 5
+    # Move the checkpoint D2H + disk write onto a background thread
+    # (device-side snapshot, latest-wins queue). False = blocking save on
+    # the update loop (the A/B baseline; both paths are commit-atomic).
+    ckpt_async: bool = True
+    # Resume from a checkpoint whose stored config fingerprint (the
+    # structure-defining subset — model/env/dtype shape) disagrees with the
+    # current config. Default False: mismatch refuses to resume.
+    resume_force: bool = False
     # XLA profiler trace export (the reference has timers but no trace
     # export, SURVEY.md §5.1): when set, the learner captures a device
     # profile of ~profile_steps updates once profile_start updates have
@@ -350,6 +361,12 @@ class Config:
     # converge onto the live policy instead of acting stale forever.
     # 0 = publish only on the update cadence.
     rebroadcast_idle_s: float = 2.0
+    # Live-membership lease at storage: a worker is a member while any of
+    # its frames (rollout or telemetry) arrived within this window; silence
+    # past it evicts the wid (storage-members-evicted counter). A NEW wid
+    # joining raises the mailbox join flag so the learner pushes current
+    # weights+ver immediately instead of waiting out rebroadcast_idle_s.
+    membership_lease_s: float = 15.0
     # ---- telemetry plane (tpu_rl.obs) ----
     # HTTP port for the storage-side exporter serving Prometheus text at
     # /metrics and staleness-aware liveness at /healthz. 0 = no server, no
@@ -455,6 +472,13 @@ class Config:
         assert self.restart_backoff_s >= 0, self.restart_backoff_s
         assert self.restart_backoff_max_s >= 0, self.restart_backoff_max_s
         assert self.rebroadcast_idle_s >= 0, self.rebroadcast_idle_s
+        assert self.loss_log_interval >= 1, self.loss_log_interval
+        assert self.model_save_interval >= 1, self.model_save_interval
+        assert self.ckpt_keep >= 1, (
+            f"ckpt_keep must be >= 1 (got {self.ckpt_keep}): GC may never "
+            "remove the newest committed checkpoint"
+        )
+        assert self.membership_lease_s > 0, self.membership_lease_s
         if self.chaos_spec:
             # Parse-check here so a bad plan fails at config load, not
             # minutes later inside a spawned child. plan.py is stdlib-only,

@@ -50,10 +50,12 @@ from tpu_rl.runtime.mailbox import (
     SLOT_ACTIVATE,
     SLOT_FORWARD_BYTES,
     SLOT_GAME_COUNT,
+    SLOT_JOIN_REQ,
     SLOT_MEAN_REW,
     SLOT_MODEL_LOADS,
     SLOT_REJECTED,
     SLOT_RELAY_DROPPED,
+    SLOT_RUN_EPOCH,
 )
 from tpu_rl.runtime.manager import STAT_WINDOW
 from tpu_rl.runtime.protocol import Protocol
@@ -100,7 +102,7 @@ class AsyncPublisher:
         )
         self._thread.start()
 
-    def publish(self, actor, ver: int = -1) -> None:
+    def publish(self, actor, ver: int = -1, epoch: int = 0) -> None:
         import jax
         import jax.numpy as jnp
 
@@ -109,7 +111,7 @@ class AsyncPublisher:
         snap = jax.tree.map(jnp.copy, actor)  # donation-proof device copy
         jax.tree.map(lambda x: x.copy_to_host_async(), snap)
         with self._cond:
-            self._pending = (snap, ver)  # latest wins
+            self._pending = (snap, ver, epoch)  # latest wins
             self._cond.notify()
 
     def _run(self) -> None:
@@ -121,16 +123,20 @@ class AsyncPublisher:
                     self._cond.wait(timeout=0.1)
                 if self._pending is None:  # closed and flushed
                     return
-                (snap, ver), self._pending = self._pending, None
+                (snap, ver, epoch), self._pending = self._pending, None
             try:
                 # "ver" is the learner update index that produced these
                 # weights: workers echo it through their rollouts so storage
                 # can measure per-worker policy staleness (tpu_rl.obs).
+                # "epoch" is the run epoch (bumped on every checkpoint
+                # resume): workers adopt and echo it so storage can fence
+                # out frames acted under a pre-crash learner incarnation.
                 self._pub.send(
                     Protocol.Model,
                     {
                         "actor": jax.device_get(snap),
                         "ver": ver,
+                        "epoch": epoch,
                         # Clock-sync echo origin (t0): workers pair this with
                         # their receive time and ship both back on their
                         # Telemetry snapshots, closing the NTP round trip at
@@ -185,6 +191,15 @@ class LearnerService:
         # branch (no fresh update) so late-joining or restarted workers stop
         # acting on a stale/random policy (chaos-plane hardening).
         self.n_rebroadcasts = 0
+        # Run epoch: 0 for a fresh run, (checkpointed epoch + 1) on every
+        # resume. Stamped on Model broadcasts/telemetry and echoed by
+        # workers; storage fences stale-epoch frames on it.
+        self.run_epoch = 0
+        # Publishes triggered by storage's join flag (a NEW worker appeared
+        # in the membership table): the joiner gets weights+ver now instead
+        # of waiting out rebroadcast_idle_s.
+        self.n_join_pushes = 0
+        self._ckpt = None  # Checkpointer while cfg.model_dir is set
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -217,15 +232,43 @@ class LearnerService:
             cfg, jax.random.key(self.seed), mesh=mesh
         )
 
-        # ---- checkpoint resume (newest index wins, SURVEY.md §5.4) ----
+        # ---- checkpoint resume (newest COMMITTED index wins) ----
+        # Full-run resume: train state + update index + learner PRNG key +
+        # run epoch, refused on config-fingerprint mismatch unless
+        # cfg.resume_force. A torn (uncommitted) save is invisible here by
+        # construction (tpu_rl/checkpoint.py's marker protocol).
+        from tpu_rl.checkpoint import resume_fingerprint
+
         ckpt = None
         start_idx = 0
+        resumed_key_data = None
+        fingerprint = resume_fingerprint(cfg)
         if cfg.model_dir:
-            ckpt = Checkpointer(cfg.model_dir, cfg.algo)
-            restored = ckpt.restore_latest(state)
+            ckpt = self._ckpt = Checkpointer(
+                cfg.model_dir,
+                cfg.algo,
+                keep=cfg.ckpt_keep,
+                async_save=cfg.ckpt_async,
+            )
+            restored = ckpt.restore_run(
+                state, fingerprint=fingerprint, force=cfg.resume_force
+            )
             if restored is not None:
-                state, start_idx = restored
-                print(f"[learner] resumed from checkpoint idx {start_idx}")
+                state, start_idx, meta = restored
+                self.run_epoch = int(meta.get("epoch", 0)) + 1
+                resumed_key_data = meta.get("key")
+                print(
+                    f"[learner] resumed from checkpoint idx {start_idx} "
+                    f"(run epoch {self.run_epoch})"
+                )
+                self._record_resume(start_idx)
+        # Publish the epoch into the cross-respawn mailbox BEFORE the first
+        # broadcast: storage (its mp.Array outlives child respawns) learns
+        # the new fence before any worker can act on the new weights, which
+        # makes stale-epoch rejection deterministic instead of a race.
+        sa = self.stat_array
+        if sa is not None and len(sa) > SLOT_RUN_EPOCH:
+            sa[SLOT_RUN_EPOCH] = float(self.run_epoch + 1)  # 0 = unknown
 
         # ---- compile: single-chip jit, data-parallel, or data x seq mesh ----
         # _wrap is reused by the entropy-anneal switch below, which rebuilds
@@ -364,6 +407,29 @@ class LearnerService:
             num_transition=cfg.seq_len * cfg.batch_size * chain
         )
         key = jax.random.key(self.seed + 1)
+        if resumed_key_data is not None:
+            # Continue the checkpointed RNG stream instead of replaying the
+            # seed's: a resumed run keeps sampling fresh subkeys.
+            import jax.numpy as jnp
+
+            try:
+                key = jax.random.wrap_key_data(
+                    jnp.asarray(resumed_key_data, dtype=jnp.uint32)
+                )
+            except (TypeError, ValueError):
+                print(
+                    "[learner] checkpointed PRNG key unreadable; keeping "
+                    "the seed-derived stream", flush=True,
+                )
+
+        def _ckpt_meta() -> dict:
+            # Captures the loop's live `key` binding: the meta snapshot is
+            # taken at save-call time, consistent with the state snapshot.
+            return {
+                "epoch": self.run_epoch,
+                "key": np.asarray(jax.random.key_data(key)).tolist(),
+                "fingerprint": fingerprint,
+            }
 
         # SEED-style centralized inference (act_mode="remote"): serve
         # batched acting from THIS process on the learner's device. Params
@@ -386,8 +452,11 @@ class LearnerService:
             self._inference.wait_ready()
 
         # First broadcast so workers act with the resumed/initial policy
-        # rather than their own random init.
+        # rather than their own random init. It answers any join request
+        # already pending (a respawned learner typically finds the flag
+        # raised: storage re-registered every worker while it was booting).
         self._publish(pub, state, ver=start_idx)
+        self._consume_join_flag()
         last_pub_m = time.monotonic()
 
         if (
@@ -434,12 +503,15 @@ class LearnerService:
                     # stale/random policy until the next update-driven
                     # publish. While the store starves, re-ship the current
                     # weights + ver on a slow clock so joiners converge.
-                    if cfg.rebroadcast_idle_s > 0:
+                    if self._maybe_join_push(pub, state, ver=idx):
+                        last_pub_m = time.monotonic()
+                    elif cfg.rebroadcast_idle_s > 0:
                         now_m = time.monotonic()
                         if now_m - last_pub_m >= cfg.rebroadcast_idle_s:
                             self._publish(pub, state, ver=idx)
                             last_pub_m = time.monotonic()
                             self.n_rebroadcasts += 1
+                    self._note_ckpt(timer)
                     if telem_reg is not None:
                         now_m = time.monotonic()
                         if now_m - telem_last >= cfg.telemetry_interval_s:
@@ -514,6 +586,9 @@ class LearnerService:
                         profiling = False
                 if _crossed(prev_idx, idx, self.publish_interval):
                     self._publish(pub, state, ver=idx)
+                    self._consume_join_flag()  # this broadcast serves joiners
+                    last_pub_m = time.monotonic()
+                elif self._maybe_join_push(pub, state, ver=idx):
                     last_pub_m = time.monotonic()
                 if telem_reg is not None:
                     now_m = time.monotonic()
@@ -531,7 +606,10 @@ class LearnerService:
                 if ckpt is not None and _crossed(
                     prev_idx, idx, cfg.model_save_interval
                 ):
-                    ckpt.save(state, idx)
+                    # Async mode: snapshot + enqueue only; the D2H, orbax
+                    # write, commit marker, and GC run on the writer thread.
+                    ckpt.save(state, idx, meta=_ckpt_meta())
+                self._note_ckpt(timer)
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
                 sa = self.stat_array
@@ -563,9 +641,13 @@ class LearnerService:
             if profiling:
                 # Never leave a trace open (early exit / stop-event / crash).
                 jax.profiler.stop_trace()
-            if ckpt is not None and idx > start_idx:
-                ckpt.save(state, idx)
+            if ckpt is not None:
+                if idx > start_idx:
+                    ckpt.save(state, idx, meta=_ckpt_meta())
+                # close() drains the pending save (the run's final weights
+                # are committed, not dropped) then joins the writer thread.
                 ckpt.close()
+                self._note_ckpt(timer)
             if telem_reg is not None:
                 # Final snapshot (then the socket): the run's closing update
                 # index reaches the aggregator even on early exit.
@@ -713,7 +795,7 @@ class LearnerService:
             else state.params["actor"]
         )
         if self._publisher is not None:
-            self._publisher.publish(actor, ver)
+            self._publisher.publish(actor, ver, epoch=self.run_epoch)
         else:
             import jax
 
@@ -722,6 +804,7 @@ class LearnerService:
                 {
                     "actor": jax.device_get(actor),
                     "ver": ver,
+                    "epoch": self.run_epoch,
                     "t_tx": time.time_ns(),
                 },
             )
@@ -730,6 +813,60 @@ class LearnerService:
             # actually pays; the blocking device_get runs on the publisher
             # thread, outside the batch timeline.
             self._tracer.add("broadcast", t0, time.perf_counter() - t0)
+
+    def _consume_join_flag(self) -> bool:
+        """Clear a pending join request and count it answered. A PUB frame
+        reaches every connected SUB, so ANY broadcast serves the joiner —
+        the update-driven publish consumes the flag too, not just the
+        dedicated idle-path push (a busy learner publishing every update
+        must not leave the flag stranded)."""
+        sa = self.stat_array
+        if sa is None or len(sa) <= SLOT_JOIN_REQ or sa[SLOT_JOIN_REQ] < 1.0:
+            return False
+        sa[SLOT_JOIN_REQ] = 0.0
+        self.n_join_pushes += 1
+        return True
+
+    def _maybe_join_push(self, pub: Pub, state, ver: int) -> bool:
+        """Storage raised the join flag (a NEW wid entered the membership
+        table): push current weights+ver immediately so the joiner does not
+        wait out rebroadcast_idle_s acting on a random/stale policy."""
+        if not self._consume_join_flag():
+            return False
+        self._publish(pub, state, ver=ver)
+        return True
+
+    def _note_ckpt(self, timer: ExecutionTimer) -> None:
+        """Fold checkpoint instrumentation into the loop's timer: wall
+        seconds of saves committed since the last call (sync or async — the
+        A/B observable) and the count still in flight."""
+        ckpt = self._ckpt
+        if ckpt is None:
+            return
+        for dur in ckpt.drain_save_secs():
+            timer.record("learner-ckpt-time", dur)
+        timer.record_gauge("learner-ckpt-pending", float(ckpt.pending))
+
+    def _record_resume(self, idx: int) -> None:
+        """Append one resume record to result_dir/learner_resume.jsonl —
+        the audit trail resume-smoke asserts monotonicity against (child
+        stdout is not capturable from the in-process smoke harness)."""
+        if self.cfg.result_dir is None:
+            return
+        import json
+
+        try:
+            os.makedirs(self.cfg.result_dir, exist_ok=True)
+            path = os.path.join(self.cfg.result_dir, "learner_resume.jsonl")
+            with open(path, "a") as f:
+                f.write(
+                    json.dumps(
+                        {"idx": idx, "epoch": self.run_epoch, "t": time.time()}
+                    )
+                    + "\n"
+                )
+        except OSError:
+            pass  # durability bookkeeping must never kill the learner
 
     def _emit_telemetry(self, reg, pub: Pub, timer: ExecutionTimer, idx: int
                         ) -> None:
@@ -742,6 +879,11 @@ class LearnerService:
         for name, val in timer.scalars().items():
             reg.gauge(name).set(val)
         reg.counter("learner-rebroadcasts").set_total(self.n_rebroadcasts)
+        reg.gauge("learner-run-epoch").set(self.run_epoch)
+        reg.counter("learner-join-pushes").set_total(self.n_join_pushes)
+        if self._ckpt is not None:
+            reg.gauge("learner-ckpt-pending").set(float(self._ckpt.pending))
+            reg.counter("learner-ckpt-saves").set_total(self._ckpt.n_saves)
         svc = self._inference
         if svc is not None:
             reg.counter("inference-requests").set_total(svc.n_requests)
@@ -754,7 +896,12 @@ class LearnerService:
                 reg.counter("inference-chaos-refusals").set_total(
                     svc.chaos.n_refused
                 )
-        pub.send(Protocol.Telemetry, reg.snapshot())
+        snap = reg.snapshot()
+        # Top-level epoch echo (same convention as workers): storage
+        # ratchets its stale-frame fence from whichever epoch source lands
+        # first — the mailbox slot normally wins, this covers remote setups.
+        snap["epoch"] = self.run_epoch
+        pub.send(Protocol.Telemetry, snap)
 
     def _log_fleet_stat(self, logger: LearnerLogger) -> None:
         """Consume the stat mailbox if storage activated it (reference

@@ -31,10 +31,12 @@ from tpu_rl.runtime.mailbox import (
     SLOT_ACTIVATE,
     SLOT_FORWARD_BYTES,
     SLOT_GAME_COUNT,
+    SLOT_JOIN_REQ,
     SLOT_MEAN_REW,
     SLOT_MODEL_LOADS,
     SLOT_REJECTED,
     SLOT_RELAY_DROPPED,
+    SLOT_RUN_EPOCH,
     STAT_SLOTS,
 )
 from tpu_rl.runtime.protocol import Protocol, unpack_trace
@@ -42,7 +44,44 @@ from tpu_rl.runtime.transport import Sub, make_data_sub
 
 # Slot layout lives in tpu_rl.runtime.mailbox (shared with the learner's
 # reader); STAT_SLOTS is re-exported here for existing importers.
-__all__ = ["LearnerStorage", "STAT_SLOTS", "storage_main"]
+__all__ = ["LearnerStorage", "MembershipTable", "STAT_SLOTS", "storage_main"]
+
+
+class MembershipTable:
+    """Lease-based live membership of acting workers, keyed by wid.
+
+    Any frame carrying a wid (RolloutBatch or Telemetry) renews the lease;
+    silence past ``lease_s`` evicts. The table is always on (one dict write
+    per frame) because the JOIN signal is functional, not observational: a
+    new wid raises the learner's immediate weight-push flag so a joining or
+    supervisor-respawned worker converges onto the live policy at once
+    instead of waiting out ``rebroadcast_idle_s``. Join/evict totals and the
+    active-count gauge surface through the telemetry plane when it's on.
+    """
+
+    def __init__(self, lease_s: float, clock=time.monotonic):
+        self.lease_s = float(lease_s)
+        self._clock = clock
+        self.active: dict[int, float] = {}  # wid -> last-seen monotonic
+        self.n_joined = 0
+        self.n_evicted = 0
+
+    def touch(self, wid: int, now: float | None = None) -> bool:
+        """Renew wid's lease; True iff this is a (re)join."""
+        now = self._clock() if now is None else now
+        joined = wid not in self.active
+        if joined:
+            self.n_joined += 1
+        self.active[wid] = now
+        return joined
+
+    def evict_expired(self, now: float | None = None) -> list[int]:
+        now = self._clock() if now is None else now
+        dead = [w for w, t in self.active.items() if now - t > self.lease_s]
+        for w in dead:
+            del self.active[w]
+            self.n_evicted += 1
+        return dead
 
 
 class LearnerStorage:
@@ -65,6 +104,18 @@ class LearnerStorage:
         self.n_windows = 0
         self.n_requeue_full = 0  # windows requeued because the store was full
         self._sub: Sub | None = None
+        # Run-epoch fence (durable-fleet plane): the highest epoch learned
+        # from the mailbox slot (primary — the mp.Array outlives child
+        # respawns, so a respawned storage re-arms instantly) or from frame
+        # echoes. Frames stamped with a KNOWN older epoch were acted under a
+        # pre-crash learner incarnation: dropped and counted here, never
+        # mixed into training and never conflated with corrupt-frame
+        # n_rejected (chaos parity). epoch < 0 = unknown, always accepted.
+        self.run_epoch = -1
+        self.n_stale_epoch = 0
+        # Worker join/leave registry (heartbeat lease over frame arrivals).
+        self.members = MembershipTable(cfg.membership_lease_s)
+        self._next_evict = 0.0
         # Telemetry plane (tpu_rl.obs): the aggregator lives HERE — storage
         # is the learner-side edge of the stat channel, the one hop every
         # role's snapshots already reach. None when disabled; every call
@@ -108,12 +159,17 @@ class LearnerStorage:
         self._setup_telemetry()
         try:
             while not self._stopped():
+                self._poll_epoch()
                 msg = sub.recv_traced(timeout_ms=50)
                 if msg is not None:
                     self._ingest(msg[0], msg[1], assembler, msg[2])
                 for proto, payload, trailer in sub.drain_traced():
                     self._ingest(proto, payload, assembler, trailer)
                 self._flush(assembler, store)
+                now_m = time.monotonic()
+                if now_m >= self._next_evict:
+                    self._next_evict = now_m + 1.0
+                    self.members.evict_expired(now_m)
                 if self.aggregator is not None:
                     self._telemetry_tick()
                 if self.heartbeat is not None:
@@ -233,6 +289,16 @@ class LearnerStorage:
             self.aggregator.n_ingested
         )
         reg.gauge("storage-game-count").set(self.game_count)
+        # Durability plane: the epoch fence and the membership lease table.
+        reg.gauge("storage-run-epoch").set(self.run_epoch)
+        reg.counter("storage-stale-epoch-frames").set_total(
+            self.n_stale_epoch
+        )
+        reg.gauge("storage-members-active").set(len(self.members.active))
+        reg.counter("storage-members-joined").set_total(self.members.n_joined)
+        reg.counter("storage-members-evicted").set_total(
+            self.members.n_evicted
+        )
         if self._chaos is not None:
             reg.counter("chaos-corrupted-frames").set_total(
                 self._chaos.n_corrupted
@@ -269,6 +335,12 @@ class LearnerStorage:
         if proto == Protocol.Rollout:
             assembler.push(payload)
         elif proto == Protocol.RolloutBatch:
+            # Membership lease BEFORE the epoch fence: a stale-epoch frame
+            # still proves its worker is alive (it is mid re-attach), and
+            # evicting it would mis-fire a join push when it converges.
+            self._touch_member(payload)
+            if not self._epoch_admit(payload):
+                return  # pre-crash incarnation's rollout: fenced + counted
             if self.aggregator is not None and isinstance(payload, dict):
                 # Policy-staleness echo (tagged on Model broadcasts, echoed
                 # by workers): how many updates behind was the policy this
@@ -294,10 +366,61 @@ class LearnerStorage:
         elif proto == Protocol.Stat:
             self._relay_stat(payload)
         elif proto == Protocol.Telemetry:
+            # Telemetry is health data: ratchet the fence and renew the
+            # lease from its epoch echo, but never reject a snapshot — a
+            # stale-epoch worker must stay visible to /healthz while it
+            # re-attaches.
+            self._touch_member(payload)
+            if isinstance(payload, dict):
+                e = payload.get("epoch")
+                if isinstance(e, int) and e > self.run_epoch:
+                    self.run_epoch = e
             if self.aggregator is not None:
                 if self.clocksync is not None and isinstance(payload, dict):
                     self._clock_sample(payload)
                 self.aggregator.ingest(payload)
+
+    # ----------------------------------------------------- durability plane
+    def _poll_epoch(self) -> None:
+        """Ratchet the fence from the learner-written mailbox slot (encoded
+        epoch + 1; 0 = no learner wrote yet). The mp.Array outlives child
+        respawns, so this wins every race against frame echoes."""
+        sa = self.stat_array
+        if sa is None or len(sa) <= SLOT_RUN_EPOCH:
+            return
+        e = int(sa[SLOT_RUN_EPOCH]) - 1
+        if e > self.run_epoch:
+            self.run_epoch = e
+
+    def _epoch_admit(self, payload) -> bool:
+        """True to ingest. A frame stamped with a known epoch older than the
+        fence is dropped and counted; unknown (< 0 or absent) is admitted —
+        fresh fleets and pre-upgrade workers must not stall."""
+        if not isinstance(payload, dict):
+            return True
+        e = payload.get("epoch")
+        if not isinstance(e, int) or e < 0:
+            return True
+        if e > self.run_epoch:
+            self.run_epoch = e  # frame echo: secondary ratchet source
+            return True
+        if e < self.run_epoch:
+            self.n_stale_epoch += 1
+            return False
+        return True
+
+    def _touch_member(self, payload) -> None:
+        """Renew the wid's membership lease; on a NEW member, raise the
+        mailbox join flag so the learner pushes weights+ver immediately."""
+        if not isinstance(payload, dict):
+            return
+        wid = payload.get("wid")
+        if not isinstance(wid, int):
+            return
+        if self.members.touch(wid):
+            sa = self.stat_array
+            if sa is not None and len(sa) > SLOT_JOIN_REQ:
+                sa[SLOT_JOIN_REQ] = 1.0
 
     def _note_ingest(self, trailer: bytes) -> int | None:
         """Record the storage-ingest hop for a sampled frame; returns its

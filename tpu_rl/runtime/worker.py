@@ -117,6 +117,11 @@ class Worker:
         # close a full NTP round trip through this worker. None until the
         # first stamped broadcast arrives.
         clk_echo: list | None = None
+        # Run epoch adopted from the newest Model broadcast; -1 = unknown
+        # (no broadcast yet). Echoed on every RolloutBatch and Telemetry
+        # frame so storage can fence out frames acted under a pre-crash
+        # learner incarnation (unknown is always accepted).
+        run_epoch = -1
         if cfg.telemetry_enabled:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
 
@@ -126,6 +131,7 @@ class Worker:
 
             def _send_snap(snap, _wid=self.worker_id):
                 snap["wid"] = _wid  # aggregator source key + UI grouping
+                snap["epoch"] = run_epoch  # membership lease + epoch fence
                 clk = {"t2": time.time_ns()}  # our clock at snapshot send
                 if clk_echo is not None:
                     clk["t0"], clk["t1"] = clk_echo
@@ -252,6 +258,7 @@ class Worker:
                     if proto == Protocol.Model:
                         params = {"actor": payload["actor"]}
                         policy_ver = int(payload.get("ver", -1))
+                        run_epoch = int(payload.get("epoch", run_epoch))
                         n_model_loads += 1
                         if registry is not None:
                             # Clock-sync echo: pair the learner's send stamp
@@ -430,6 +437,7 @@ class Worker:
                         done=dones,
                         wid=self.worker_id,
                         ver=tick_ver,
+                        epoch=run_epoch,
                     ),
                     trace=trailer,
                 )
@@ -462,6 +470,7 @@ class Worker:
                             int(dones.sum())
                         )
                     registry.gauge("worker-policy-version").set(tick_ver)
+                    registry.gauge("worker-run-epoch").set(run_epoch)
                     registry.counter("worker-model-loads").set_total(
                         n_model_loads
                     )
