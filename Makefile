@@ -2,14 +2,31 @@
 
 PY ?= python
 
-.PHONY: lint format-check test native-build protocol-matrix relay-smoke \
-	obs-smoke trace-smoke chaos-smoke colocated-smoke resume-smoke ci
+.PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
+	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
+	resume-smoke ci
 
 lint:
 	ruff check .
 
 format-check:
 	ruff format --check .
+
+# Repo-native static analysis plane (tools/analysis): hot-path purity,
+# jit-boundary hygiene, protocol/mailbox consistency, metric/config drift,
+# thread discipline. Exit 0 = clean (waivers live in tools/analysis/baseline.toml).
+analyze:
+	$(PY) -m tools.analysis
+
+# mypy --strict over the protocol-critical core (wire format, mailbox, shm
+# rings). Skips gracefully where mypy isn't installed — CI always runs it.
+typecheck:
+	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
+		$(PY) -m mypy tpu_rl/runtime/protocol.py tpu_rl/runtime/mailbox.py \
+			tpu_rl/runtime/transport.py; \
+	else \
+		echo "mypy not installed; skipping typecheck (CI runs it)"; \
+	fi
 
 # Tier-1 suite: the fast CPU gate (slow-marked cluster/e2e tests excluded).
 test:
@@ -80,5 +97,5 @@ colocated-smoke:
 resume-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/resume_smoke.py
 
-ci: lint test protocol-matrix relay-smoke obs-smoke trace-smoke chaos-smoke \
-	colocated-smoke resume-smoke
+ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
+	trace-smoke chaos-smoke colocated-smoke resume-smoke

@@ -43,7 +43,7 @@ def make_batch(cfg, fam, key=42):
 def _leaf_diff(a, b):
     la = jax.tree_util.tree_leaves(a)
     lb = jax.tree_util.tree_leaves(b)
-    return max(float(jnp.max(jnp.abs(x - y))) for x, y in zip(la, lb))
+    return max(float(jnp.max(jnp.abs(x - y))) for x, y in zip(la, lb, strict=True))
 
 
 @pytest.mark.parametrize("algo", ALGOS)

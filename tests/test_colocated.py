@@ -115,7 +115,7 @@ def _feed_assembler(loop: ColocatedLoop, n_windows: int, seed: int = 0):
 
 def _assert_windows_equal(coloc, ref):
     assert len(coloc) == len(ref) > 0
-    for i, (cw, rw) in enumerate(zip(coloc, ref)):
+    for i, (cw, rw) in enumerate(zip(coloc, ref, strict=True)):
         for f in BATCH_FIELDS:
             np.testing.assert_array_equal(
                 cw[f], rw[f],
@@ -199,7 +199,7 @@ def test_fused_update_matches_standalone(env, algo):
         _copy(state0), _copy(carry0), loop.init_stats(), k_roll, k_train
     )
 
-    for a, b in zip(jax.tree.leaves(state_dist), jax.tree.leaves(state_fused)):
+    for a, b in zip(jax.tree.leaves(state_dist), jax.tree.leaves(state_fused), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for k in metrics_dist:
         np.testing.assert_array_equal(

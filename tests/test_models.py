@@ -213,7 +213,7 @@ def test_mixed_dot_bf16_both_passes():
         lambda a, b: ((a @ b) * w).sum(), argnums=(0, 1)
     )(a, b)
     np.testing.assert_allclose(float(v16), float(v32), rtol=2e-2)
-    for x16, x32 in zip(g16, g32):
+    for x16, x32 in zip(g16, g32, strict=True):
         np.testing.assert_allclose(
             np.asarray(x16), np.asarray(x32), rtol=5e-2, atol=0.2
         )

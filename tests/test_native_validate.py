@@ -36,8 +36,8 @@ BAD_MAGIC, OVERSIZED, RAW_MISMATCH, BAD_CODEC = 4, 5, 6, 7
 BAD_TRAILER, BAD_CRC = 8, 9
 
 
-def _good(payload={"x": 1}, proto=Protocol.RolloutBatch, trace=None):
-    return encode(proto, payload, trace=trace)
+def _good(payload=None, proto=Protocol.RolloutBatch, trace=None):
+    return encode(proto, payload if payload is not None else {"x": 1}, trace=trace)
 
 
 def _trailer():
@@ -113,7 +113,7 @@ class TestBatchVerdicts:
         frame — the contract that lets drains swap implementations."""
         frames, _, _ = _matrix()
         got = native.validate_batch(frames, TRACE_KINDS_MASK, MAX_PROTO)
-        for frame, verdict in zip(frames, got):
+        for frame, verdict in zip(frames, got, strict=True):
             try:
                 peek(frame)
                 py_ok = True
@@ -128,7 +128,7 @@ class TestBatchVerdicts:
         got = native.validate_batch(
             frames, TRACE_KINDS_MASK, MAX_PROTO, check_crc=True
         )
-        for frame, verdict in zip(frames, got):
+        for frame, verdict in zip(frames, got, strict=True):
             try:
                 decode(frame)
                 py_ok = True
@@ -184,7 +184,7 @@ class TestValidateHelpers:
         keep = [i for i, v in enumerate(peek_v) if v == OK]
         assert rejected == len(frames) - len(keep)
         assert [parts for _, parts in got] == [frames[i] for i in keep]
-        for (proto, parts), i in zip(got, keep):
+        for (proto, parts), i in zip(got, keep, strict=True):
             assert proto == Protocol(frames[i][0][0])
 
     @pytest.mark.parametrize("use_native", [False, True])
@@ -196,7 +196,7 @@ class TestValidateHelpers:
         keep = [i for i, v in enumerate(crc_v) if v == OK]
         assert rejected == len(frames) - len(keep)
         assert len(got) == len(keep)
-        for (proto, payload, trailer), i in zip(got, keep):
+        for (proto, payload, trailer), i in zip(got, keep, strict=True):
             ref_proto, ref_payload = decode(frames[i])
             assert proto == ref_proto
             assert trailer == (frames[i][2] if len(frames[i]) == 3 else None)

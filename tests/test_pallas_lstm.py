@@ -64,7 +64,7 @@ def test_kernel_gradients_match_scan(lstm_setup):
     flat_s = jax.tree_util.tree_leaves(g_scan)
     flat_k = jax.tree_util.tree_leaves(g_kern)
     assert len(flat_s) == len(flat_k)
-    for a, b in zip(flat_k, flat_s):
+    for a, b in zip(flat_k, flat_s, strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
 
@@ -102,6 +102,7 @@ def test_full_train_step_with_kernel(rng):
     for a, b in zip(
         jax.tree_util.tree_leaves(s1.params),
         jax.tree_util.tree_leaves(s2.params),
+        strict=True,
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
@@ -137,6 +138,7 @@ def test_dp_mesh_shard_map_island(devices, rng):
     for a, b in zip(
         jax.tree_util.tree_leaves(s_ref.params),
         jax.tree_util.tree_leaves(s_mesh.params),
+        strict=True,
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
@@ -180,7 +182,8 @@ def test_batch_tiled_grid_matches_scan(rng, monkeypatch):
     )
     np.testing.assert_allclose(float(v_kern), float(v_scan), rtol=1e-5)
     for a, b in zip(
-        jax.tree_util.tree_leaves(g_kern), jax.tree_util.tree_leaves(g_scan)
+        jax.tree_util.tree_leaves(g_kern), jax.tree_util.tree_leaves(g_scan),
+        strict=True,
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 

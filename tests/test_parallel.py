@@ -91,7 +91,7 @@ def test_dp_matches_single_device(algo):
                   s.log_alpha)
         )
 
-    for a, b in zip(leaves(ref_state), leaves(dp_state)):
+    for a, b in zip(leaves(ref_state), leaves(dp_state), strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5)
 
 
@@ -152,7 +152,7 @@ def test_chained_step_matches_sequential(algo):
                   s.log_alpha)
         )
 
-    for a, b in zip(leaves(ref_state), leaves(c_state)):
+    for a, b in zip(leaves(ref_state), leaves(c_state), strict=True):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
         )

@@ -308,7 +308,7 @@ def test_pipelined_matches_synchronous_bit_exact(tmp_path):
     want = jax.tree_util.tree_leaves(sync_state.params)
     have = jax.tree_util.tree_leaves(pipe_state.params)
     assert want and len(want) == len(have)
-    for a, b in zip(want, have):
+    for a, b in zip(want, have, strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     # The new pipeline instrumentation must have populated its windows.

@@ -153,7 +153,7 @@ class TestWireSerializer:
         np.testing.assert_array_equal(
             out["nested"]["params"]["w"], payload["nested"]["params"]["w"]
         )
-        for got, want in zip(out["arrays"], payload["arrays"]):
+        for got, want in zip(out["arrays"], payload["arrays"], strict=True):
             want = np.asarray(want)
             assert got.dtype == want.dtype
             np.testing.assert_array_equal(got, want)

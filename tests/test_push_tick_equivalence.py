@@ -87,7 +87,7 @@ def test_push_tick_bit_identical_to_per_step_push(seed, n_envs):
     assert len(wins_a) == len(wins_b) > 0
     assert a.stats["spliced"] > 0, "stream never exercised a splice seam"
     assert a.stats["dropped_stale"] > 0, "stream never exercised a stale drop"
-    for wa, wb in zip(wins_a, wins_b):
+    for wa, wb in zip(wins_a, wins_b, strict=True):
         for f in BATCH_FIELDS:
             np.testing.assert_array_equal(wa[f], wb[f], err_msg=f)
 

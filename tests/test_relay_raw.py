@@ -31,8 +31,8 @@ from tpu_rl.runtime.protocol import (
 from tpu_rl.runtime.transport import Pub, Sub
 
 
-def _frame(payload={"x": 1}, proto=Protocol.RolloutBatch):
-    return encode(proto, payload)
+def _frame(payload=None, proto=Protocol.RolloutBatch):
+    return encode(proto, payload if payload is not None else {"x": 1})
 
 
 class TestPeek:

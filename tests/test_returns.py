@@ -120,5 +120,5 @@ def test_vtrace_value_clamp_bounds_hallucination(rng):
     out_clip = vtrace(
         behav, target, fir, rew, v_ok, gamma=0.99, v_min=0.0, v_max=cap
     )
-    for a, b in zip(out_ref, out_clip):
+    for a, b in zip(out_ref, out_clip, strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

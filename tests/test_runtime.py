@@ -352,7 +352,7 @@ def test_learner_chain_matches_sequential_through_shm(tmp_path):
     assert idx == n_updates
     want = jax.tree_util.tree_leaves(state.params)
     have = jax.tree_util.tree_leaves(got.params)
-    for a, b in zip(want, have):
+    for a, b in zip(want, have, strict=True):
         np_.testing.assert_allclose(
             np_.asarray(a), np_.asarray(b), rtol=2e-5, atol=1e-6
         )
@@ -376,7 +376,7 @@ def test_checkpoint_roundtrip(tmp_path):
     assert idx == 200
     orig = jax.tree_util.tree_leaves(state.params)
     rest = jax.tree_util.tree_leaves(restored.params)
-    for a, b in zip(orig, rest):
+    for a, b in zip(orig, rest, strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     # gc keeps only the newest `keep`
     ckpt.save(state, 300)

@@ -80,7 +80,7 @@ def test_sharded_gradients_match(devices, rng, impl_name):
 
     g_want = jax.grad(loss_full)((q, k, v))
     g_got = jax.jit(jax.grad(loss_sharded))((q, k, v))
-    for a, b in zip(g_got, g_want):
+    for a, b in zip(g_got, g_want, strict=True):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
 
 
@@ -190,7 +190,7 @@ class TestBlockwiseAttention:
         vf, gf = jax.value_and_grad(loss_full, argnums=(0, 1, 2))(q, k, v)
         vb, gb = jax.value_and_grad(loss_blk, argnums=(0, 1, 2))(q, k, v)
         np.testing.assert_allclose(float(vb), float(vf), rtol=2e-5)
-        for a, b in zip(gb, gf):
+        for a, b in zip(gb, gf, strict=True):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
             )

@@ -42,8 +42,8 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _frame(payload={"x": 1}, proto=Protocol.RolloutBatch, trace=None):
-    return encode(proto, payload, trace=trace)
+def _frame(payload=None, proto=Protocol.RolloutBatch, trace=None):
+    return encode(proto, payload if payload is not None else {"x": 1}, trace=trace)
 
 
 def _drain_until(consumer, n, timeout=10.0):

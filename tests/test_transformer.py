@@ -133,6 +133,7 @@ class TestSequenceParallelTrainStep:
         for a, b in zip(
             jax.tree_util.tree_leaves(s1.params),
             jax.tree_util.tree_leaves(s2.params),
+            strict=True,
         ):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-4

@@ -1,0 +1,21 @@
+"""Repo-native static analysis plane (``python -m tools.analysis``).
+
+Five AST-based checkers enforce the conventions the runtime tests can only
+observe dynamically:
+
+- ``hotpath``:  allocation/logging discipline on the tracemalloc-pinned
+  relay/ingest paths (see ``checks/hotpath.py`` for the manifest).
+- ``jit``:      no host syncs or untraced side effects inside ``jax.jit`` /
+  ``lax.scan`` bodies.
+- ``protocol``: wire-struct sizes match declared byte constants, TRACE_KINDS
+  stays inside the Protocol enum, mailbox SLOT_* constants are unique and
+  contiguous, and no code indexes the stat mailbox with a bare number.
+- ``drift``:    metric names in code and in ARCHITECTURE.md's tables agree
+  both ways; Config fields are validated or explicitly exempted; the CLI
+  override map only names real Config fields.
+- ``threads``:  declared background threads only write shared attributes
+  under a lock/condition or through the per-thread allowlist.
+
+Waivers live in ``baseline.toml`` (max 10, every entry carries a reason);
+fixture-driven tests for each checker are in ``tests/test_analysis.py``.
+"""

@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.analysis [--check NAME ...] [--no-baseline]``.
+
+Exit codes: 0 = clean (waived findings and stale waivers are reported but
+don't fail), 1 = unwaived findings, 2 = a checker or the baseline itself is
+broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.analysis.checks import ALL_CHECKS
+from tools.analysis.engine import (
+    BASELINE_PATH,
+    REPO_ROOT,
+    apply_baseline,
+    load_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="python -m tools.analysis")
+    p.add_argument(
+        "--check", action="append", choices=sorted(ALL_CHECKS),
+        help="run only this checker (repeatable; default: all)",
+    )
+    p.add_argument("--root", default=str(REPO_ROOT), help="repo root to scan")
+    p.add_argument(
+        "--baseline", default=str(BASELINE_PATH),
+        help="waiver baseline toml (default: tools/analysis/baseline.toml)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, waived or not",
+    )
+    args = p.parse_args(argv)
+
+    root = Path(args.root)
+    names = args.check or sorted(ALL_CHECKS)
+    findings = []
+    for name in names:
+        try:
+            findings.extend(ALL_CHECKS[name].run(root))
+        except Exception as e:  # a broken checker must fail loudly, not pass
+            print(f"error: checker {name!r} crashed: {e!r}", file=sys.stderr)
+            return 2
+
+    if args.no_baseline:
+        kept, waived, stale = findings, [], []
+    else:
+        try:
+            waivers = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"error: bad baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        kept, waived, stale = apply_baseline(findings, waivers)
+
+    for f in sorted(kept, key=lambda f: (f.path, f.line, f.code)):
+        print(f.render())
+    for w in stale:
+        print(
+            f"warning: stale waiver ({w.check}/{w.code} {w.path} {w.symbol}) "
+            "matched nothing — remove it from baseline.toml",
+            file=sys.stderr,
+        )
+    checked = ", ".join(names)
+    print(
+        f"tools.analysis: {len(kept)} finding(s), {len(waived)} waived, "
+        f"{len(stale)} stale waiver(s) [{checked}]",
+        file=sys.stderr,
+    )
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

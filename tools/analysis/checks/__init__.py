@@ -1,0 +1,15 @@
+"""Checker registry: every module here exposes ``NAME`` and ``run(root)``."""
+
+from __future__ import annotations
+
+from tools.analysis.checks import (
+    drift,
+    hotpath,
+    jit_boundary,
+    protocol_check,
+    threads,
+)
+
+ALL_CHECKS = {
+    m.NAME: m for m in (hotpath, jit_boundary, protocol_check, drift, threads)
+}

@@ -1,0 +1,338 @@
+"""Metric and config drift.
+
+Metrics: every name registered in code (``registry.counter/gauge/histogram``
+with a literal name, ``timer.record``/``record_gauge``, and the ``*_GAUGE`` /
+``*_HIST`` string constants in ``tpu_rl/obs``) must appear in one of
+ARCHITECTURE.md's metric tables, and every documented name must exist in
+code. Doc rows may use ``fnmatch`` wildcards (the ``learner-*`` family row);
+a wildcard that matches nothing is itself drift. Registry names must not be
+registered under two different kinds (timer-plane mirrors of fleet counters
+are exempt: the learner re-exports mailbox aggregates as timer gauges by
+design — see ``_log_fleet_stat``).
+
+Config: every ``Config`` field is either read inside ``Config.validate`` or
+listed in ``CONFIG_VALIDATE_EXEMPT`` with a reason. The CLI override map in
+``__main__.load_config`` may only assign keys that are real Config fields,
+and every ``--flag``/``args.X`` pair must line up both ways.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+import fnmatch
+
+from tools.analysis.engine import Finding, REPO_ROOT, parse_file, rel
+
+NAME = "drift"
+
+DOC_FILE = "docs/ARCHITECTURE.md"
+CODE_DIR = "tpu_rl"
+CONFIG_FILE = "tpu_rl/config.py"
+MAIN_FILE = "tpu_rl/__main__.py"
+
+_REGISTRY_KINDS = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}
+_TIMER_METHODS = {"record", "record_gauge"}
+_CONST_SUFFIX_KINDS = {"_GAUGE": "gauge", "_HIST": "histogram", "_METRIC": "counter"}
+_DOC_HEADER = re.compile(r"^\|\s*Name\s*\|\s*Kind\s*\|")
+_METRIC_NAME = re.compile(r"^[a-z0-9*]+(-[a-z0-9*]+)+$")
+
+# Config fields deliberately outside ``validate`` — every entry carries the
+# why. Adding a field without either a validate read or a row here is DR010.
+CONFIG_VALIDATE_EXEMPT: dict[str, str] = {
+    "result_dir": "free-form output path; None = no artifacts",
+    "model_dir": "free-form checkpoint path; None = derived from result_dir",
+    "profile_dir": "free-form XLA trace path; None = profiler off",
+    "is_gray": "boolean; both values valid",
+    "ckpt_async": "boolean A/B switch; both values valid",
+    "resume_force": "boolean escape hatch; both values valid",
+    "reset_carry_on_first": "boolean parity switch; both values valid",
+    "stop_at_reward": "any float is a legal stop bar; None = run full budget",
+    "policy_loss_coef": "any float is a legal loss weight (0 disables the term)",
+    "value_loss_coef": "any float is a legal loss weight (0 disables the term)",
+    "entropy_coef": "any float is a legal loss weight (0 disables the term)",
+    "v_mpo_lagrange_multiplier_init": "algo-specific init; positivity enforced by softplus in algos/vmpo.py",
+    "coef_alpha_upper": "V-MPO dual lr; any positive-ish float, consumed by optax",
+    "coef_alpha_below": "V-MPO dual lr; any positive-ish float, consumed by optax",
+    "chaos_seed": "any int seeds the per-site RNG streams",
+    "obs_shape": "runtime-derived by probe_spaces, never user-set",
+    "action_space": "runtime-derived by probe_spaces, never user-set",
+}
+
+
+# ------------------------------------------------------------------ metrics
+def extract_code_metrics(
+    paths: list[Path], root: Path
+) -> list[tuple[str, str, str, int]]:
+    """-> [(name, kind, rel_path, line)]; kind in counter/gauge/histogram/timer."""
+    out: list[tuple[str, str, str, int]] = []
+    for p in paths:
+        rel_path = rel(p, root)
+        tree = parse_file(p)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                kind = _REGISTRY_KINDS.get(attr)
+                if kind is None and attr in _TIMER_METHODS:
+                    kind = "timer"
+                if kind is None or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    out.append((arg.value, kind, rel_path, node.lineno))
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                name = node.targets[0].id
+                for suffix, kind in _CONST_SUFFIX_KINDS.items():
+                    if name.endswith(suffix):
+                        out.append((node.value.value, kind, rel_path, node.lineno))
+                        break
+    return out
+
+
+def extract_doc_metrics(path: str | Path) -> list[tuple[str, int]]:
+    """Metric names from every ``| Name | Kind | ... |`` table -> [(name, line)]."""
+    out: list[tuple[str, int]] = []
+    in_table = False
+    for lineno, line in enumerate(Path(path).read_text().splitlines(), 1):
+        stripped = line.strip()
+        if _DOC_HEADER.match(stripped):
+            in_table = True
+            continue
+        if in_table:
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            first_cell = stripped.strip("|").split("|", 1)[0]
+            for token in re.findall(r"`([^`]+)`", first_cell):
+                if _METRIC_NAME.match(token):
+                    out.append((token, lineno))
+    return out
+
+
+def compare_metrics(
+    code: list[tuple[str, str, str, int]],
+    doc: list[tuple[str, int]],
+    doc_rel: str = DOC_FILE,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    doc_exact = {n for n, _ in doc if "*" not in n}
+    doc_globs = [(n, ln) for n, ln in doc if "*" in n]
+    code_names = {n for n, _, _, _ in code}
+
+    seen: set[str] = set()
+    for name, kind, path, line in code:
+        if name in seen:
+            continue
+        seen.add(name)
+        if name in doc_exact or any(
+            fnmatch.fnmatch(name, g) for g, _ in doc_globs
+        ):
+            continue
+        findings.append(
+            Finding(
+                NAME, "DR001", path, line, name,
+                f"metric {name!r} ({kind}) is not documented in "
+                f"{doc_rel}'s metric tables",
+            )
+        )
+    for name, line in doc:
+        if "*" in name:
+            if not any(fnmatch.fnmatch(c, name) for c in code_names):
+                findings.append(
+                    Finding(
+                        NAME, "DR002", doc_rel, line, name,
+                        f"documented metric family {name!r} matches nothing in code",
+                    )
+                )
+        elif name not in code_names:
+            findings.append(
+                Finding(
+                    NAME, "DR002", doc_rel, line, name,
+                    f"documented metric {name!r} does not exist in code "
+                    "(renamed or removed?)",
+                )
+            )
+
+    # Kind collisions among registry metrics (timer mirrors exempt).
+    kinds: dict[str, set[str]] = {}
+    first_site: dict[str, tuple[str, int]] = {}
+    for name, kind, path, line in code:
+        if kind == "timer":
+            continue
+        kinds.setdefault(name, set()).add(kind)
+        first_site.setdefault(name, (path, line))
+    for name, ks in sorted(kinds.items()):
+        if len(ks) > 1:
+            path, line = first_site[name]
+            findings.append(
+                Finding(
+                    NAME, "DR003", path, line, name,
+                    f"metric {name!r} is registered as {sorted(ks)} — one "
+                    "name, one kind",
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------------- config
+def check_config(
+    path: str | Path, rel_path: str, exempt: dict[str, str] = CONFIG_VALIDATE_EXEMPT
+) -> list[Finding]:
+    tree = parse_file(path)
+    findings: list[Finding] = []
+    cfg_class = next(
+        (
+            n
+            for n in tree.body
+            if isinstance(n, ast.ClassDef) and n.name == "Config"
+        ),
+        None,
+    )
+    if cfg_class is None:
+        return [Finding(NAME, "DR010", rel_path, 1, "Config", "Config class not found")]
+    fields: dict[str, int] = {}
+    validate_fn = None
+    for stmt in cfg_class.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            fields[stmt.target.id] = stmt.lineno
+        elif isinstance(stmt, ast.FunctionDef) and stmt.name == "validate":
+            validate_fn = stmt
+    if validate_fn is None:
+        return [
+            Finding(NAME, "DR010", rel_path, cfg_class.lineno, "Config",
+                    "Config.validate not found")
+        ]
+    covered = {
+        n.attr
+        for n in ast.walk(validate_fn)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.value, ast.Name)
+        and n.value.id == "self"
+    }
+    for field, line in sorted(fields.items()):
+        if field in covered or field in exempt:
+            continue
+        findings.append(
+            Finding(
+                NAME, "DR010", rel_path, line, f"Config.{field}",
+                f"field {field!r} is neither read in Config.validate nor "
+                "exempted in CONFIG_VALIDATE_EXEMPT (checks/drift.py)",
+            )
+        )
+    for field in sorted(exempt):
+        if field not in fields:
+            findings.append(
+                Finding(
+                    NAME, "DR010", rel_path, 1, f"Config.{field}",
+                    f"CONFIG_VALIDATE_EXEMPT names {field!r}, which is not a "
+                    "Config field (stale exemption)",
+                )
+            )
+    return findings
+
+
+def check_cli(
+    path: str | Path, rel_path: str, config_fields: set[str]
+) -> list[Finding]:
+    tree = parse_file(path)
+    findings: list[Finding] = []
+    flag_dests: set[str] = set()
+    args_used: dict[str, int] = {}
+    override_keys: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                dest = next(
+                    (
+                        kw.value.value
+                        for kw in node.keywords
+                        if kw.arg == "dest"
+                        and isinstance(kw.value, ast.Constant)
+                    ),
+                    node.args[0].value.lstrip("-").replace("-", "_"),
+                )
+                flag_dests.add(dest)
+        elif (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "args"
+        ):
+            args_used.setdefault(node.attr, node.lineno)
+        elif (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+            and isinstance(node.targets[0].value, ast.Name)
+            and node.targets[0].value.id == "overrides"
+            and isinstance(node.targets[0].slice, ast.Constant)
+            and isinstance(node.targets[0].slice.value, str)
+        ):
+            override_keys.setdefault(node.targets[0].slice.value, node.lineno)
+
+    for attr, line in sorted(args_used.items()):
+        if attr not in flag_dests:
+            findings.append(
+                Finding(
+                    NAME, "DR011", rel_path, line, f"args.{attr}",
+                    f"args.{attr} is read but no add_argument declares that "
+                    "dest — the CLI would crash on access",
+                )
+            )
+    for dest in sorted(flag_dests):
+        if dest not in args_used:
+            findings.append(
+                Finding(
+                    NAME, "DR012", rel_path, 1, f"--{dest.replace('_', '-')}",
+                    f"flag dest {dest!r} is declared but never read from args "
+                    "(dead flag)",
+                )
+            )
+    for key, line in sorted(override_keys.items()):
+        if key not in config_fields:
+            findings.append(
+                Finding(
+                    NAME, "DR013", rel_path, line, key,
+                    f"CLI override targets {key!r}, which is not a Config "
+                    "field — the override would be silently dropped by "
+                    "Config.replace",
+                )
+            )
+    return findings
+
+
+def _config_fields(path: Path) -> set[str]:
+    tree = parse_file(path)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return {
+                s.target.id
+                for s in node.body
+                if isinstance(s, ast.AnnAssign) and isinstance(s.target, ast.Name)
+            }
+    return set()
+
+
+def run(root: Path = REPO_ROOT) -> list[Finding]:
+    code_files = sorted((root / CODE_DIR).rglob("*.py"))
+    code_metrics = extract_code_metrics(code_files, root)
+    doc_metrics = extract_doc_metrics(root / DOC_FILE)
+    findings = compare_metrics(code_metrics, doc_metrics)
+    findings.extend(check_config(root / CONFIG_FILE, CONFIG_FILE))
+    findings.extend(
+        check_cli(root / MAIN_FILE, MAIN_FILE, _config_fields(root / CONFIG_FILE))
+    )
+    return findings

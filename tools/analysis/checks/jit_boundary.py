@@ -1,0 +1,159 @@
+"""Jit-boundary discipline: functions handed to ``jax.jit`` or used as a
+``lax.scan`` body must stay traceable — no host syncs (``float()``,
+``.item()``, ``np.asarray``/``np.array`` on traced values) and no untraced
+side effects (``print``, ``time.*``). Any of these either crashes at trace
+time on an abstract value or, worse, silently runs once at trace time and
+never again.
+
+Traced-function discovery is syntactic, matching how this repo spells it:
+
+- ``jax.jit(f, ...)`` / ``jax.jit(self._body, ...)`` call form,
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators,
+- ``lax.scan(f, ...)``, including ``lax.scan(lambda c, x: self._tick(...))``
+  where the names called inside the lambda are traced too.
+
+Collected names resolve to same-module defs by their last qualname segment.
+``int()``/``bool()`` are deliberately not flagged: they appear in static
+shape math on concrete Python values throughout the parallel layers.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.analysis.engine import (
+    Finding,
+    REPO_ROOT,
+    iter_functions,
+    parse_file,
+    rel,
+    terminal_name,
+)
+
+NAME = "jit"
+
+# Files/dirs holding jit or scan bodies (repo-relative).
+TARGETS = (
+    "tpu_rl/runtime/colocated.py",
+    "tpu_rl/runtime/inference_service.py",
+    "tpu_rl/runtime/learner_service.py",
+    "tpu_rl/runtime/worker.py",
+    "tpu_rl/parallel",
+    "tpu_rl/algos",
+    "tpu_rl/ops",
+)
+
+_HOST_SYNC_CALLS = {
+    "float": ("JB005", "float() forces a host sync on a traced value"),
+    "item": ("JB003", ".item() forces a host sync on a traced value"),
+    "asarray": ("JB004", "np.asarray materializes a traced value on host"),
+    "array": ("JB004", "np.array materializes a traced value on host"),
+}
+
+
+def _collect_traced_names(tree: ast.Module) -> set[str]:
+    """Bare names of functions this module traces via jit or scan."""
+    traced: set[str] = set()
+
+    def note(arg: ast.expr) -> None:
+        t = terminal_name(arg)
+        if t is not None:
+            traced.add(t)
+        elif isinstance(arg, ast.Lambda):
+            # scan(lambda c, x: self._tick(...)): the lambda body is inline
+            # — trace every function it calls by name.
+            for sub in ast.walk(arg.body):
+                if isinstance(sub, ast.Call):
+                    st = terminal_name(sub.func)
+                    if st is not None:
+                        traced.add(st)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t == "jit" and node.args:
+                note(node.args[0])
+            elif t == "scan" and node.args:
+                note(node.args[0])
+            elif t == "partial" and node.args:
+                # partial(jax.jit, ...) used as a decorator factory
+                if terminal_name(node.args[0]) == "jit" and len(node.args) > 1:
+                    note(node.args[1])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                if terminal_name(base) == "jit":
+                    traced.add(node.name)
+                elif (
+                    isinstance(dec, ast.Call)
+                    and terminal_name(dec.func) == "partial"
+                    and dec.args
+                    and terminal_name(dec.args[0]) == "jit"
+                ):
+                    traced.add(node.name)
+    traced.discard("jit")
+    traced.discard("scan")
+    return traced
+
+
+def _visit(fn: ast.AST, qualname: str, path: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        t = terminal_name(node.func)
+        if t == "print":
+            findings.append(
+                Finding(
+                    NAME, "JB001", path, node.lineno, qualname,
+                    "print inside a traced body runs at trace time only",
+                )
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time"
+        ):
+            findings.append(
+                Finding(
+                    NAME, "JB002", path, node.lineno, qualname,
+                    f"time.{t}() inside a traced body is evaluated once at "
+                    "trace time, not per step",
+                )
+            )
+        elif t in _HOST_SYNC_CALLS:
+            # np.asarray/np.array only when spelled through np/numpy;
+            # bare float()/.item() always.
+            if t in ("asarray", "array"):
+                if not (
+                    isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy")
+                ):
+                    continue
+            code, msg = _HOST_SYNC_CALLS[t]
+            findings.append(Finding(NAME, code, path, node.lineno, qualname, msg))
+    return findings
+
+
+def scan_file(path: str | Path, rel_path: str) -> list[Finding]:
+    tree = parse_file(path)
+    traced = _collect_traced_names(tree)
+    if not traced:
+        return []
+    findings: list[Finding] = []
+    for qualname, fn in iter_functions(tree):
+        if qualname.rsplit(".", 1)[-1] in traced:
+            findings.extend(_visit(fn, qualname, rel_path))
+    return findings
+
+
+def run(root: Path = REPO_ROOT) -> list[Finding]:
+    findings: list[Finding] = []
+    for target in TARGETS:
+        p = root / target
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(scan_file(f, rel(f, root)))
+    return findings

@@ -1,0 +1,166 @@
+"""Shared machinery for the checker suite: findings, AST helpers, baseline.
+
+A ``Finding`` identifies one violation; the baseline (``baseline.toml``)
+waives findings by (check, code, path, symbol) — never by line number, so a
+waiver survives unrelated edits above it but dies with the symbol it names.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+from pathlib import Path
+from typing import Iterator
+
+try:  # Python 3.11+
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - 3.10 fallback
+    import tomli as tomllib  # type: ignore[no-redef]
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.toml"
+
+# Hard cap on committed waivers: past this the baseline is hiding debt, not
+# recording it — fix the findings instead.
+MAX_WAIVERS = 10
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One checker violation, addressable for waiving and for tests."""
+
+    check: str  # checker name ("hotpath", "jit", ...)
+    code: str  # stable rule id ("HP001", ...)
+    path: str  # repo-relative posix path
+    line: int  # 1-based
+    symbol: str  # dotted qualname of the offending function, or "<module>"
+    message: str
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.code} [{self.check}] "
+            f"{self.symbol}: {self.message}"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    """One baseline entry. ``path`` may be an fnmatch glob; ``symbol`` may
+    be ``*`` to waive the rule for the whole file."""
+
+    check: str
+    code: str
+    path: str
+    symbol: str
+    reason: str
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.check == self.check
+            and f.code == self.code
+            and fnmatch.fnmatch(f.path, self.path)
+            and self.symbol in ("*", f.symbol)
+        )
+
+
+def load_baseline(path: str | Path = BASELINE_PATH) -> list[Waiver]:
+    """Parse and validate the waiver baseline; raises ValueError on an
+    unjustified entry or on more than MAX_WAIVERS entries."""
+    data = tomllib.loads(Path(path).read_text())
+    waivers: list[Waiver] = []
+    for i, entry in enumerate(data.get("waiver", [])):
+        reason = str(entry.get("reason", "")).strip()
+        if not reason:
+            raise ValueError(f"baseline waiver #{i + 1} has no reason: {entry}")
+        for key in ("check", "code", "path"):
+            if not entry.get(key):
+                raise ValueError(f"baseline waiver #{i + 1} missing {key!r}")
+        waivers.append(
+            Waiver(
+                check=str(entry["check"]),
+                code=str(entry["code"]),
+                path=str(entry["path"]),
+                symbol=str(entry.get("symbol", "*")),
+                reason=reason,
+            )
+        )
+    if len(waivers) > MAX_WAIVERS:
+        raise ValueError(
+            f"baseline holds {len(waivers)} waivers, cap is {MAX_WAIVERS}: "
+            "fix findings instead of waiving them"
+        )
+    return waivers
+
+
+def apply_baseline(
+    findings: list[Finding], waivers: list[Waiver]
+) -> tuple[list[Finding], list[Finding], list[Waiver]]:
+    """-> (kept, waived, stale_waivers). A waiver that matched nothing is
+    stale — reported so the baseline shrinks as findings get fixed."""
+    kept: list[Finding] = []
+    waived: list[Finding] = []
+    used: set[int] = set()
+    for f in findings:
+        hit = None
+        for i, w in enumerate(waivers):
+            if w.matches(f):
+                hit = i
+                break
+        if hit is None:
+            kept.append(f)
+        else:
+            used.add(hit)
+            waived.append(f)
+    stale = [w for i, w in enumerate(waivers) if i not in used]
+    return kept, waived, stale
+
+
+def parse_file(path: str | Path) -> ast.Module:
+    return ast.parse(Path(path).read_text(), filename=str(path))
+
+
+def rel(path: str | Path, root: str | Path = REPO_ROOT) -> str:
+    return Path(path).resolve().relative_to(Path(root).resolve()).as_posix()
+
+
+def iter_functions(
+    tree: ast.Module,
+) -> Iterator[tuple[str, ast.FunctionDef | ast.AsyncFunctionDef]]:
+    """Yield (dotted qualname, node) for every def, including those nested
+    inside classes and other defs ("Outer.__init__.Handler.do_GET")."""
+
+    def walk(node: ast.AST, prefix: str) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")
+
+
+def terminal_name(node: ast.expr) -> str | None:
+    """The last identifier of a Name or dotted Attribute chain
+    (``jax.lax.scan`` -> "scan"), else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """Full dotted form of a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

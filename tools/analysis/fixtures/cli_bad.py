@@ -1,0 +1,8 @@
+"""Seeded CLI fixture: phantom args read, dead flag, non-Config override."""
+
+
+def main(parser, args, overrides):
+    parser.add_argument("--lr")
+    parser.add_argument("--dead-flag")
+    overrides["lr"] = args.lr
+    overrides["ghost_field"] = args.batch

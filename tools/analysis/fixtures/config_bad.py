@@ -1,0 +1,9 @@
+"""Seeded config fixture: ``batch`` is neither validated nor exempted."""
+
+
+class Config:
+    lr: float = 1e-3
+    batch: int = 32
+
+    def validate(self):
+        assert self.lr > 0

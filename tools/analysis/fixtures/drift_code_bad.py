@@ -1,0 +1,8 @@
+"""Seeded drift code fixture: undocumented metric plus a kind collision."""
+
+
+class M:
+    def go(self, reg):
+        reg.counter("relay-frames")
+        reg.counter("orphan-metric")
+        reg.gauge("relay-frames")

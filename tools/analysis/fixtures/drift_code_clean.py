@@ -1,0 +1,7 @@
+"""Clean drift code fixture: both metrics appear in drift_doc_clean.md."""
+
+
+class M:
+    def go(self, reg):
+        reg.counter("relay-frames")
+        reg.gauge("queue-depth")

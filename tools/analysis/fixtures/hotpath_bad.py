@@ -1,0 +1,13 @@
+"""Seeded hot-path fixture: one violation per code, lines pinned by tests."""
+import json
+
+
+class Ring:
+    def hot_send(self, buf, parts):
+        name = f"ring-{len(parts)}"
+        name2 = "ring-{}".format(len(parts))
+        name3 = "ring-%d" % len(parts)
+        lens = [len(p) for p in parts]
+        meta = {"n": len(parts)}
+        print(name, name2, name3, lens, meta)
+        return json.dumps(meta)

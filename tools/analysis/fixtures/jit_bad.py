@@ -1,0 +1,17 @@
+"""Seeded jit fixture: one host-sync violation per code, lines pinned."""
+import time
+
+import jax
+import numpy as np
+
+
+def _body(x):
+    print(x)
+    t = time.time()
+    v = x.item()
+    a = np.asarray(x)
+    f = float(v)
+    return a, t, f
+
+
+step = jax.jit(_body)

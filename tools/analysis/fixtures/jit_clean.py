@@ -1,0 +1,9 @@
+"""Clean jit fixture: pure traced body, zero findings expected."""
+import jax
+
+
+def _body(x):
+    return x * 2
+
+
+step = jax.jit(_body)

@@ -1,0 +1,4 @@
+"""Seeded mailbox fixture: slot gap plus wrong STAT_SLOTS."""
+SLOT_A = 0
+SLOT_B = 2
+STAT_SLOTS = 3

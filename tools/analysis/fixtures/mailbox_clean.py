@@ -1,0 +1,4 @@
+"""Clean mailbox fixture: contiguous slots, matching total."""
+SLOT_A = 0
+SLOT_B = 1
+STAT_SLOTS = 2

@@ -1,0 +1,6 @@
+"""Seeded slot-usage fixture: bare integer indices into the stat mailbox."""
+
+
+def f(sa):
+    sa[3] = 1.0
+    return sa[0]

@@ -1,0 +1,9 @@
+"""Seeded thread fixture: two unguarded writes around one guarded write."""
+
+
+class W:
+    def _run(self):
+        self.count = 0
+        with self._lock:
+            self.ok = True
+        self.count += 1

@@ -1,0 +1,7 @@
+"""Clean thread fixture: the only shared write happens under the lock."""
+
+
+class W:
+    def _run(self):
+        with self._lock:
+            self.done = True

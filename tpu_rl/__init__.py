@@ -2,7 +2,7 @@
 
 A clean-room JAX/XLA re-design of the capabilities of
 ``ymg1114/pytorch-distributed-reinforcement-learning`` (see /root/repo/SURVEY.md):
-an IMPALA-style actor–learner architecture with six algorithms (PPO, PPO-Continuous,
+an IMPALA-style actor-learner architecture with six algorithms (PPO, PPO-Continuous,
 IMPALA/V-trace, V-MPO, SAC, SAC-Continuous), a fleet of CPU env workers streaming
 trajectories over ZMQ through per-machine manager relays into a learner-host storage
 process, and a mesh-data-parallel TPU learner compiled with ``jax.jit``.

@@ -424,6 +424,35 @@ class Config:
         assert self.buffer_size >= self.batch_size
         assert 0.0 <= self.gamma <= 1.0
         assert 0.0 <= self.lmbda <= 1.0
+        # Structural/positivity gates for the remaining numeric knobs —
+        # every Config field is either read here or exempted (with a reason)
+        # in tools/analysis/checks/drift.py's CONFIG_VALIDATE_EXEMPT.
+        assert self.height >= 1 and self.width >= 1, (self.height, self.width)
+        assert self.hidden_size >= 1, self.hidden_size
+        assert self.n_heads >= 1 and self.n_layers >= 1, (
+            self.n_heads, self.n_layers,
+        )
+        assert self.act_ctx >= 0, self.act_ctx
+        assert self.time_horizon >= 1, self.time_horizon
+        assert self.reward_scale != 0.0, (
+            "reward_scale 0 zeroes every reward — no learning signal"
+        )
+        assert self.eps_clip > 0, self.eps_clip
+        assert self.alpha > 0, self.alpha
+        assert 0.0 < self.tau <= 1.0, self.tau
+        assert self.alpha_min >= 0, self.alpha_min
+        assert self.alpha_lr is None or self.alpha_lr > 0, self.alpha_lr
+        assert 0.0 < self.rho_min <= self.rho_bar, (self.rho_min, self.rho_bar)
+        assert self.c_bar > 0, self.c_bar
+        assert self.coef_eta > 0, self.coef_eta
+        assert self.K_epoch >= 1, self.K_epoch
+        assert self.lr > 0, self.lr
+        assert self.max_grad_norm > 0, self.max_grad_norm
+        assert self.profile_start >= 0, self.profile_start
+        assert self.profile_steps >= 1, self.profile_steps
+        assert self.mesh_data >= 1, self.mesh_data
+        assert self.worker_step_sleep >= 0, self.worker_step_sleep
+        assert self.rollout_lag_sec > 0, self.rollout_lag_sec
         assert self.compute_dtype in (
             "float32",
             "bfloat16",

@@ -96,7 +96,9 @@ def render_prometheus(agg: TelemetryAggregator, now: float | None = None) -> str
             hists[name], key=lambda s: _labels_str(s[0])
         ):
             cum = 0
-            for bound, c in zip(HIST_BUCKETS, counts):
+            # counts carries one extra overflow slot past the last bound; the
+            # +Inf line below renders it, so the truncating zip is deliberate.
+            for bound, c in zip(HIST_BUCKETS, counts, strict=False):
                 cum += c
                 le = {**labels, "le": repr(bound)}
                 lines.append(f"{pname}_bucket{_labels_str(le)} {cum}")

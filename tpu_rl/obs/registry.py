@@ -214,7 +214,7 @@ def merge_snapshots(a: dict, b: dict) -> dict:
             key = (name, tuple(sorted(labels.items())))
             if key in hists:
                 h = hists[key]
-                h[2] = [x + y for x, y in zip(h[2], counts)]
+                h[2] = [x + y for x, y in zip(h[2], counts, strict=True)]
                 h[3] += total
                 h[4] += count
             else:
@@ -246,7 +246,7 @@ def diff_snapshots(cur: dict, prev: dict) -> dict:
                 [
                     name,
                     dict(labels),
-                    [max(0, x - y) for x, y in zip(counts, p[2])],
+                    [max(0, x - y) for x, y in zip(counts, p[2], strict=True)],
                     max(0.0, total - p[3]),
                     max(0, count - p[4]),
                 ]

@@ -328,7 +328,7 @@ class InferenceService:
         lp_np = np.asarray(log_prob)
         h_pre_np = np.asarray(h_pre) if store_carry else None
         c_pre_np = np.asarray(c_pre) if store_carry else None
-        for req, off in zip(chunk, offsets):
+        for req, off in zip(chunk, offsets, strict=True):
             n = req.obs.shape[0]
             client = self.clients[req.identity]
             # lax.dynamic_slice-free row updates: device-side slicing keeps

@@ -618,13 +618,15 @@ class LearnerService:
                     and sa is not None
                     # window full: a real STAT_WINDOW-game mean, not a
                     # lucky few-episode start
-                    and sa[0] >= STAT_WINDOW
-                    and sa[1] >= cfg.stop_at_reward
+                    and sa[SLOT_GAME_COUNT] >= STAT_WINDOW
+                    and sa[SLOT_MEAN_REW] >= cfg.stop_at_reward
                 ):
-                    logger.log_stat(int(sa[0]), float(sa[1]))
+                    logger.log_stat(
+                        int(sa[SLOT_GAME_COUNT]), float(sa[SLOT_MEAN_REW])
+                    )
                     logger.flush()
                     print(
-                        f"[learner] fleet 50-game mean {sa[1]:.1f} >= "
+                        f"[learner] fleet 50-game mean {sa[SLOT_MEAN_REW]:.1f} >= "
                         f"stop_at_reward {cfg.stop_at_reward}: solved, "
                         f"stopping at update {idx}", flush=True,
                     )

@@ -114,7 +114,7 @@ def pack(obj: Any) -> bytes:
 class _Reader:
     __slots__ = ("buf", "pos")
 
-    def __init__(self, buf: bytes):
+    def __init__(self, buf: bytes) -> None:
         self.buf = buf
         self.pos = 0
 
@@ -286,6 +286,15 @@ class Codec(enum.IntEnum):
 
 _MAGIC = 0x5452  # "TR"
 _HEADER = struct.Struct("<HBBII")  # magic, version, codec, raw_size, crc32
+# Declared wire size of the frame header. The assert makes a format edit
+# fail at import instead of silently skewing every peek/encode offset; the
+# static twin lives in tools/analysis (protocol checker, PC001).
+HEADER_BYTES = 12
+assert _HEADER.size == HEADER_BYTES, (
+    f"frame header format {_HEADER.format!r} packs {_HEADER.size} bytes, "
+    f"declared HEADER_BYTES is {HEADER_BYTES} — update both together "
+    "(and bump _VERSION: this is a wire-format change)"
+)
 _VERSION = 1
 _MIN_COMPRESS = 128  # bytes; below this, framing overhead beats compression
 # Hard ceiling on a frame's declared decompressed size: a hostile header may
@@ -308,6 +317,15 @@ _TRAILER_VERSION = 1
 # magic u16, version u8, pad, wid i32, frame seq u32, trace id u64,
 # sender's time.time_ns() at send i64
 _TRAILER = struct.Struct("<HBxiIQq")
+# Declared wire size of the trace trailer — the 28-byte third part every
+# relay validates in O(1). Same contract as HEADER_BYTES above: a format
+# edit must fail here, not skew unpack_trace/_check_trailer offsets.
+TRAILER_BYTES = 28
+assert _TRAILER.size == TRAILER_BYTES, (
+    f"trace trailer format {_TRAILER.format!r} packs {_TRAILER.size} bytes, "
+    f"declared TRAILER_BYTES is {TRAILER_BYTES} — update both together "
+    "(and bump _TRAILER_VERSION: this is a wire-format change)"
+)
 # The only kinds that may carry a trailer: the rollout data plane. A trailer
 # on anything else (Model, Stat, control frames) is a hostile/corrupt frame
 # and is rejected into the receiver's ``n_rejected`` path.

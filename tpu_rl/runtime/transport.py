@@ -70,7 +70,7 @@ def _validate_raw(
         verdicts = native.validate_batch(frames, TRACE_KINDS_MASK, MAX_PROTO)
         out = [
             (Protocol(parts[0][0]), parts)
-            for parts, v in zip(frames, verdicts)
+            for parts, v in zip(frames, verdicts, strict=True)
             if v == 0
         ]
         return out, len(frames) - len(out)
@@ -95,7 +95,7 @@ def _validate_traced(
         verdicts = native.validate_batch(
             frames, TRACE_KINDS_MASK, MAX_PROTO, check_crc=True
         )
-        for parts, v in zip(frames, verdicts):
+        for parts, v in zip(frames, verdicts, strict=True):
             if v != 0:
                 rejected += 1
                 continue
@@ -123,7 +123,7 @@ class Pub:
     reference too, ``agents/learner.py:85-90``)."""
 
     def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM,
-                 ctx=None, chaos=None):
+                 ctx: Any = None, chaos: Any = None) -> None:
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.PUB)
         self.sock.set_hwm(hwm)
@@ -170,7 +170,8 @@ class Sub:
     fabric must not crash a role process."""
 
     def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM,
-                 ctx=None, chaos=None, native_batch: bool = True):
+                 ctx: Any = None, chaos: Any = None,
+                 native_batch: bool = True) -> None:
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.SUB)
         self.sock.set_hwm(hwm)
@@ -320,7 +321,7 @@ class Router:
     one corrupt client must not crash the inference server."""
 
     def __init__(self, ip: str, port: int, bind: bool = True,
-                 hwm: int = DATA_HWM, ctx=None):
+                 hwm: int = DATA_HWM, ctx: Any = None) -> None:
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.ROUTER)
         self.sock.set_hwm(hwm)
@@ -379,7 +380,8 @@ class Dealer:
     machinery beyond the payload's own ``seq`` echo is needed."""
 
     def __init__(self, ip: str, port: int, bind: bool = False,
-                 hwm: int = DATA_HWM, identity: bytes | None = None, ctx=None):
+                 hwm: int = DATA_HWM, identity: bytes | None = None,
+                 ctx: Any = None) -> None:
         self._ctx = ctx or zmq.Context.instance()
         self.sock = self._ctx.socket(zmq.DEALER)
         self.sock.set_hwm(hwm)
@@ -412,7 +414,8 @@ class AsyncSub:
     """asyncio SUB endpoint (storage/manager event loops, reference
     ``zmq.asyncio`` usage)."""
 
-    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM,
+                 ctx: Any = None) -> None:
         self._ctx = ctx or zmq.asyncio.Context.instance()
         self.sock = self._ctx.socket(zmq.SUB)
         self.sock.set_hwm(hwm)
@@ -434,7 +437,8 @@ class AsyncSub:
 
 
 class AsyncPub:
-    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM, ctx=None):
+    def __init__(self, ip: str, port: int, bind: bool, hwm: int = DATA_HWM,
+                 ctx: Any = None) -> None:
         self._ctx = ctx or zmq.asyncio.Context.instance()
         self.sock = self._ctx.socket(zmq.PUB)
         self.sock.set_hwm(hwm)
@@ -502,6 +506,27 @@ _RSEQ, _RPOS = 64, 72
 _CTL_NONCE, _CTL_CAP, _CTL_BITMAP = 8, 16, 24
 _SEQLOCK_SPINS = 10_000
 
+# Per-part-count record framing structs ("<B{n}I" preamble, "<{n}I" length
+# table), cached so the ring's per-record write/read never rebuilds a format
+# string — the hot-path purity checker (tools/analysis) holds these
+# functions to zero per-call formatting.
+_PREAMBLE_STRUCTS: dict[int, struct.Struct] = {}
+_LENS_STRUCTS: dict[int, struct.Struct] = {}
+
+
+def _preamble_struct(nparts: int) -> struct.Struct:
+    s = _PREAMBLE_STRUCTS.get(nparts)
+    if s is None:
+        s = _PREAMBLE_STRUCTS[nparts] = struct.Struct("<B%dI" % nparts)
+    return s
+
+
+def _lens_struct(nparts: int) -> struct.Struct:
+    s = _LENS_STRUCTS.get(nparts)
+    if s is None:
+        s = _LENS_STRUCTS[nparts] = struct.Struct("<%dI" % nparts)
+    return s
+
 
 def _ctl_name(port: int) -> str:
     return f"tpurl-{port}-ctl"
@@ -553,7 +578,7 @@ class _RingWriter:
 
     __slots__ = ("_shm", "buf", "cap", "wpos", "_wseq")
 
-    def __init__(self, shm: shared_memory.SharedMemory, capacity: int):
+    def __init__(self, shm: shared_memory.SharedMemory, capacity: int) -> None:
         self._shm = shm
         self.buf = shm.buf
         self.cap = capacity
@@ -589,12 +614,12 @@ class _RingWriter:
     def write(self, parts: list[bytes]) -> bool:
         """Copy one multipart record in; False = ring full (caller counts
         the drop — same shed-newest behavior as a PUB at HWM)."""
-        if not parts or len(parts) > 255:
+        nparts = len(parts)
+        if not nparts or nparts > 255:
             return False
-        pre = struct.pack(
-            f"<B{len(parts)}I", len(parts), *[len(p) for p in parts]
-        )
-        rec = len(pre) + sum(len(p) for p in parts)
+        lens = list(map(len, parts))
+        pre = _preamble_struct(nparts).pack(nparts, *lens)
+        rec = len(pre) + sum(lens)
         rpos = self._read_rpos()
         if rpos is None or self.wpos + rec - rpos > self.cap:
             return False
@@ -618,7 +643,7 @@ class _RingReader:
 
     __slots__ = ("_shm", "buf", "cap", "rpos", "_rseq", "n_resync")
 
-    def __init__(self, shm: shared_memory.SharedMemory, capacity: int):
+    def __init__(self, shm: shared_memory.SharedMemory, capacity: int) -> None:
         self._shm = shm
         self.buf = shm.buf
         self.cap = capacity
@@ -661,9 +686,9 @@ class _RingReader:
                 self.n_resync += 1
                 pos = wpos
                 break
-            lens = struct.unpack(f"<{nparts}I", self._get(pos + 1, 4 * nparts))
+            lens = _lens_struct(nparts).unpack(self._get(pos + 1, 4 * nparts))
             end = pos + 1 + 4 * nparts + sum(lens)
-            if end > wpos or any(n > self.cap for n in lens):
+            if end > wpos or max(lens) > self.cap:
                 self.n_resync += 1
                 pos = wpos
                 break
@@ -695,7 +720,7 @@ class ShmPub:
     _RETRY_S = 0.2  # how often to re-attempt rendezvous with no consumer
     _CHECK_S = 1.0  # how often to verify the consumer session nonce
 
-    def __init__(self, port: int, chaos=None):
+    def __init__(self, port: int, chaos: Any = None) -> None:
         self.port = port
         self._chaos = chaos
         self._writer: _RingWriter | None = None
@@ -806,7 +831,7 @@ class ShmConsumer:
     claimed producer ring. Raw frames only — validation/decode layers on top
     (:class:`FanInSub`)."""
 
-    def __init__(self, port: int, capacity: int = SHM_RING_BYTES):
+    def __init__(self, port: int, capacity: int = SHM_RING_BYTES) -> None:
         self.port = port
         self.cap = capacity
         _unlink_stale(port)
@@ -888,8 +913,9 @@ class FanInSub:
     _SLICE_MS = 5  # zmq poll slice while also watching the shm side
 
     def __init__(self, ip: str, port: int, bind: bool = True,
-                 hwm: int = DATA_HWM, ctx=None, chaos=None,
-                 capacity: int = SHM_RING_BYTES, native_batch: bool = True):
+                 hwm: int = DATA_HWM, ctx: Any = None, chaos: Any = None,
+                 capacity: int = SHM_RING_BYTES,
+                 native_batch: bool = True) -> None:
         self._zmq = Sub(ip, port, bind=bind, hwm=hwm, ctx=ctx, chaos=chaos,
                         native_batch=native_batch)
         self.shm = ShmConsumer(port, capacity=capacity)
@@ -998,13 +1024,14 @@ def is_loopback(ip: str) -> bool:
     return ip in ("127.0.0.1", "localhost", "::1", "*", "0.0.0.0")
 
 
-def use_shm(cfg, ip: str) -> bool:
+def use_shm(cfg: Any, ip: str) -> bool:
     transport = getattr(cfg, "transport", "tcp")
     return transport == "shm" or (transport == "auto" and is_loopback(ip))
 
 
-def make_data_pub(cfg, ip: str, port: int, bind: bool = False,
-                  hwm: int = DATA_HWM, ctx=None, chaos=None):
+def make_data_pub(cfg: Any, ip: str, port: int, bind: bool = False,
+                  hwm: int = DATA_HWM, ctx: Any = None,
+                  chaos: Any = None) -> "Pub | ShmPub":
     """Producer endpoint for a DATA hop (rollout/stat/telemetry fan-in),
     honoring ``Config.transport``. The model broadcast is NOT a data hop —
     it fans OUT to remote workers and always stays TCP."""
@@ -1013,8 +1040,9 @@ def make_data_pub(cfg, ip: str, port: int, bind: bool = False,
     return Pub(ip, port, bind=bind, hwm=hwm, ctx=ctx, chaos=chaos)
 
 
-def make_data_sub(cfg, ip: str, port: int, bind: bool = True,
-                  hwm: int = DATA_HWM, ctx=None, chaos=None):
+def make_data_sub(cfg: Any, ip: str, port: int, bind: bool = True,
+                  hwm: int = DATA_HWM, ctx: Any = None,
+                  chaos: Any = None) -> "Sub | FanInSub":
     """Consumer endpoint for a DATA hop: a :class:`FanInSub` (shm + TCP)
     whenever shm producers may exist, else the plain TCP :class:`Sub`."""
     if getattr(cfg, "transport", "tcp") != "tcp":

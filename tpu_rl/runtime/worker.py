@@ -82,6 +82,60 @@ class Worker:
         self.n_reprobes = 0
         self.n_restores = 0
 
+    # ------------------------------------------------- cold one-time/fault
+    # Helpers kept OUT of run(): the tick loop's function is held to the
+    # hot-path purity gate's fmt tier (tools/analysis), so all string
+    # rendering lives here on the cold setup/fault paths.
+    def _init_tracer(self, cfg: Config):
+        """Build the trace recorder + dump path and install the flight
+        recorder; -> (tracer, trace_path)."""
+        from tpu_rl.obs import TraceRecorder, flightrec
+
+        tracer = TraceRecorder(
+            capacity=cfg.trace_capacity, pid=os.getpid(), role="worker"
+        )
+        trace_path = os.path.join(
+            cfg.result_dir, f"trace-worker-{os.getpid()}.json"
+        )
+        flightrec.install(
+            "worker",
+            cfg.result_dir,
+            tracer=tracer,
+            cfg=cfg,
+            extra=lambda: {
+                "fell_back": self.fell_back,
+                "n_fallbacks": self.n_fallbacks,
+                "n_reprobes": self.n_reprobes,
+                "n_restores": self.n_restores,
+            },
+        )
+        return tracer, trace_path
+
+    def _log_fallback(self, cfg: Config, reprobe_backoff: float) -> None:
+        """Log (once per fallback) the drop from remote to local acting."""
+        print(
+            f"[worker {self.worker_id}] inference service "
+            f"unreachable after "
+            f"{cfg.inference_retries + 1} attempts of "
+            f"{cfg.inference_timeout_ms} ms; falling back to "
+            f"local acting"
+            + (
+                f" (re-probing every {reprobe_backoff:.0f}s)"
+                if cfg.inference_reprobe_s > 0
+                else " permanently"
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def _log_restore(self) -> None:
+        print(
+            f"[worker {self.worker_id}] inference service "
+            "reachable again; remote acting restored",
+            file=sys.stderr,
+            flush=True,
+        )
+
     # ------------------------------------------------------------------ run
     def run(self) -> None:
         import jax
@@ -151,26 +205,7 @@ class Worker:
         tracer = None
         trace_path = None
         if cfg.result_dir is not None:
-            from tpu_rl.obs import TraceRecorder, flightrec
-
-            tracer = TraceRecorder(
-                capacity=cfg.trace_capacity, pid=os.getpid(), role="worker"
-            )
-            trace_path = os.path.join(
-                cfg.result_dir, f"trace-worker-{os.getpid()}.json"
-            )
-            flightrec.install(
-                "worker",
-                cfg.result_dir,
-                tracer=tracer,
-                cfg=cfg,
-                extra=lambda: {
-                    "fell_back": self.fell_back,
-                    "n_fallbacks": self.n_fallbacks,
-                    "n_reprobes": self.n_reprobes,
-                    "n_restores": self.n_restores,
-                },
-            )
+            tracer, trace_path = self._init_tracer(cfg)
 
         family = build_family(cfg)
         key = jax.random.key(self.seed * 9973 + self.worker_id)
@@ -274,20 +309,7 @@ class Worker:
                     # last broadcast params — a dead server must never
                     # wedge the fleet — and schedule a re-probe so a
                     # RESTARTED server regains this client.
-                    print(
-                        f"[worker {self.worker_id}] inference service "
-                        f"unreachable after "
-                        f"{cfg.inference_retries + 1} attempts of "
-                        f"{cfg.inference_timeout_ms} ms; falling back to "
-                        f"local acting"
-                        + (
-                            f" (re-probing every {reprobe_backoff:.0f}s)"
-                            if cfg.inference_reprobe_s > 0
-                            else " permanently"
-                        ),
-                        file=sys.stderr,
-                        flush=True,
-                    )
+                    self._log_fallback(cfg, reprobe_backoff)
                     remote_rejected += remote.n_rejected
                     remote.close()
                     remote = None
@@ -322,12 +344,7 @@ class Worker:
                         self.n_restores += 1
                         reprobe_backoff = cfg.inference_reprobe_s
                         next_reprobe = None
-                        print(
-                            f"[worker {self.worker_id}] inference service "
-                            "reachable again; remote acting restored",
-                            file=sys.stderr,
-                            flush=True,
-                        )
+                        self._log_restore()
                     else:
                         remote_rejected += probe.n_rejected
                         probe.close()
