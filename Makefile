@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
-	resume-smoke ci
+	resume-smoke slo-smoke ci
 
 lint:
 	ruff check .
@@ -97,5 +97,11 @@ colocated-smoke:
 resume-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/resume_smoke.py
 
+# SLO-plane smoke: the same small cluster twice under Config.slo_spec — a
+# meetable three-rule spec must scrape green on /slo and exit 0; adding an
+# impossible rule with slo_fail_run armed must scrape 503 and exit nonzero.
+slo-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/slo_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
-	trace-smoke chaos-smoke colocated-smoke resume-smoke
+	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke

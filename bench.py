@@ -54,24 +54,16 @@ import numpy as np
 
 REFERENCE_BASELINE_TPS = 600.0  # see module docstring
 
-# bf16 peak FLOPs/s per chip by device kind (public spec sheets). MFU is
-# reported against bf16 peak regardless of compute dtype (standard MFU
-# convention); None (e.g. CPU test runs) -> mfu omitted.
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6": 918e12,  # Trillium
-}
-
-
-def device_peak_flops() -> float | None:
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAK_FLOPS.items():
-        if kind.startswith(k) or k in kind:
-            return v
-    return None
+# Peak-FLOPs table + analytical-FLOPs extraction live in the runtime
+# performance plane (tpu_rl/obs/perf.py) and are imported here, so the
+# offline matrix and the live learner-mfu gauge can never disagree on the
+# denominator or the cost-analysis handling. Names re-exported for
+# existing importers of bench.PEAK_FLOPS / bench.device_peak_flops.
+from tpu_rl.obs.perf import (  # noqa: E402
+    PEAK_FLOPS,  # noqa: F401 — re-export
+    compiled_flops,
+    device_peak_flops,
+)
 
 
 def _make_batch(cfg, family):
@@ -163,12 +155,11 @@ def bench_one(
 
     lowered = pstep.lower(state, batch, key)
     compiled = lowered.compile()
-    cost = compiled.cost_analysis() or {}
     # XLA's cost analysis counts a scan/while body ONCE regardless of trip
     # count (verified: the K=4 chained program reports the same total flops
     # as the unchained step), so the chained program's count already IS
     # per-update.
-    flops_per_step = float(cost.get("flops", 0.0))
+    flops_per_step = compiled_flops(compiled)
 
     metrics = None
     for _ in range(warmup):
@@ -318,6 +309,69 @@ WORKLOADS: list[tuple[str, dict, int, int, int]] = [
 ]
 
 
+def perf_crosscheck(warmup: int = 3, iters: int = 30) -> dict:
+    """Live performance plane vs this file's offline methodology on the SAME
+    compiled program at the reference quantum: ``PerfTracker``'s one-time AOT
+    capture must report the same analytical FLOPs as the inline
+    ``cost_analysis`` here, and its windowed achieved-FLOPs/s must agree with
+    the wall-clock number within timing noise (the tier-1 test pins 15%).
+    This is the structural guarantee that ``learner-mfu`` on a dashboard
+    means the same thing as the committed bench table."""
+    from tpu_rl.algos.registry import get_algo
+    from tpu_rl.config import Config
+    from tpu_rl.obs.perf import PerfTracker
+    from tpu_rl.parallel import (
+        make_mesh,
+        make_parallel_train_step,
+        replicate,
+        shard_batch,
+    )
+
+    cfg = Config.from_dict(dict(algo="IMPALA", **_REF, **_DISC))
+    family, state, train_step = get_algo(cfg.algo).build(cfg, jax.random.key(0))
+    mesh = make_mesh(1)
+    pstep = make_parallel_train_step(train_step, mesh, cfg)
+    batch = shard_batch(_make_batch(cfg, family), mesh)
+    state = replicate(state, mesh)
+    key = replicate(jax.random.key(1), mesh)
+
+    flops_offline = compiled_flops(pstep.lower(state, batch, key).compile())
+    tracker = PerfTracker(n_devices=1)
+    tracker.capture(pstep, state, batch, key)
+
+    metrics = None
+    for _ in range(warmup):
+        state, metrics = pstep(state, batch, key)
+    if metrics is not None:
+        _sync(metrics)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        t_it = time.perf_counter()
+        state, metrics = pstep(state, batch, key)
+        _sync(metrics)
+        tracker.note(time.perf_counter() - t_it)
+    dt = time.perf_counter() - t0
+
+    achieved_offline = flops_offline * iters / dt if dt > 0 else 0.0
+    achieved_live = tracker.achieved_flops_per_s() or 0.0
+    return {
+        "flops_per_step_offline": flops_offline,
+        "flops_per_step_live": tracker.flops_per_call,
+        "flops_agreement": (
+            round(tracker.flops_per_call / flops_offline, 4)
+            if flops_offline else None
+        ),
+        "achieved_flops_per_s_offline": round(achieved_offline, 1),
+        "achieved_flops_per_s_live": round(achieved_live, 1),
+        "achieved_agreement": (
+            round(achieved_live / achieved_offline, 4)
+            if achieved_offline else None
+        ),
+        "recompiles": tracker.recompiles,
+        "iters": iters,
+    }
+
+
 def run_all(out_path: str | None = None) -> dict:
     rows = []
     workloads = WORKLOADS
@@ -353,6 +407,11 @@ def run_all(out_path: str | None = None) -> dict:
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "rows": rows,
     }
+    try:
+        # Live-plane agreement section: one cheap row, never aborts the run.
+        result["perf_plane"] = perf_crosscheck()
+    except Exception as e:  # noqa: BLE001
+        result["perf_plane"] = {"error": f"{type(e).__name__}: {e}"}
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
 

@@ -1,0 +1,200 @@
+"""SLO-plane smoke: run the smallest real cluster twice under declarative
+SLO rules (``Config.slo_spec``). Phase 1 carries a three-rule spec the run
+can meet — ``/slo`` must report passing and storage must exit 0. Phase 2
+adds an impossible rule with ``slo_fail_run`` armed — ``/slo`` must report
+failing (HTTP 503) and storage must exit NONZERO. Exits nonzero on any
+failure — this is the ``make slo-smoke`` CI gate.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/slo_smoke.py \
+      [--updates 6] [--base-port 30600] [--telemetry-port 30660]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Three rules over metrics every distributed run produces: a tail-latency
+# bound (staleness histogram, in updates), a worst-case resource gauge, and
+# a fleet-summed failure rate (the ISSUE's example rule — no corruption is
+# injected here, so the rate must hold at 0/s).
+PASSING_SPEC = (
+    "p99:policy-staleness-updates<10000,"
+    "gauge:storage-rss-bytes>0,"
+    "rate:transport-rejected-frames<1/s"
+)
+# A live storage process can never hold under one byte of RSS.
+IMPOSSIBLE_RULE = "gauge:storage-rss-bytes<1"
+
+
+def _get_slo(port: int, timeout: float = 3.0):
+    """GET /slo -> (status, parsed doc) — 503 carries the failing verdict."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/slo", timeout=timeout
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, None
+    except (urllib.error.URLError, ConnectionError, OSError, ValueError):
+        return None, None
+
+
+def run_phase(
+    name: str,
+    spec: str,
+    fail_run: bool,
+    base_port: int,
+    telemetry_port: int,
+    updates: int,
+    timeout: float,
+):
+    """One cluster run under `spec`; returns (slo scrapes, storage exitcode,
+    final slo.json doc or None, failure strings)."""
+    from tests.conftest import small_config
+    from tpu_rl.config import MachinesConfig, WorkerMachine
+    from tpu_rl.runtime.runner import local_cluster
+
+    run_dir = tempfile.mkdtemp(prefix=f"slo_smoke_{name}_")
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        worker_step_sleep=0.0,
+        learner_device="cpu",
+        rollout_lag_sec=30.0,
+        time_horizon=100,
+        loss_log_interval=2,
+        result_dir=run_dir,
+        telemetry_port=telemetry_port,
+        telemetry_interval_s=0.5,
+        telemetry_stale_s=120.0,
+        slo_spec=spec,
+        slo_fail_run=fail_run,
+    )
+    machines = MachinesConfig(
+        learner_ip="127.0.0.1",
+        learner_port=base_port,
+        workers=[WorkerMachine(
+            num_p=2, manager_ip="127.0.0.1", ip="127.0.0.1",
+            port=base_port + 5,
+        )],
+    )
+    failures: list[str] = []
+    scrapes: list = []
+    print(f"[slo-smoke] {name}: cluster up; run_dir={run_dir}", flush=True)
+    sup = local_cluster(cfg, machines, max_updates=updates)
+    try:
+        learner = next(c for c in sup.children if c.name == "learner")
+        deadline = time.time() + timeout
+        # Scrape /slo until every rule has data (or the learner finishes) —
+        # the verdict must come from the engine, not from rule silence.
+        while time.time() < deadline:
+            status, doc = _get_slo(telemetry_port)
+            if status in (200, 503) and doc is not None:
+                scrapes.append((status, doc))
+                if doc.get("no_data", 0) == 0 and doc.get("rules"):
+                    break
+            if not learner.proc.is_alive():
+                break
+            time.sleep(0.5)
+        while time.time() < deadline and learner.proc.is_alive():
+            time.sleep(0.5)
+        if learner.proc.is_alive() or learner.proc.exitcode != 0:
+            failures.append(
+                f"{name}: learner did not complete cleanly "
+                f"(alive={learner.proc.is_alive()}, "
+                f"exitcode={learner.proc.exitcode})"
+            )
+    finally:
+        sup.stop()
+
+    storage = next(c for c in sup.children if c.name == "storage")
+    exitcode = storage.proc.exitcode
+    final_doc = None
+    try:
+        with open(os.path.join(run_dir, "slo.json")) as f:
+            final_doc = json.load(f)
+    except (OSError, ValueError) as e:
+        failures.append(f"{name}: slo.json invalid: {type(e).__name__}: {e}")
+    if not scrapes:
+        failures.append(f"{name}: /slo never answered with a verdict")
+    return scrapes, exitcode, final_doc, failures
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--updates", type=int, default=6)
+    p.add_argument("--base-port", type=int, default=30600)
+    p.add_argument("--telemetry-port", type=int, default=30660)
+    p.add_argument("--timeout", type=float, default=240.0)
+    args = p.parse_args()
+    failures: list[str] = []
+
+    # ---- phase 1: meetable spec, /slo green, clean exit ----------------
+    scrapes, exitcode, final_doc, errs = run_phase(
+        "pass", PASSING_SPEC, fail_run=True,
+        base_port=args.base_port, telemetry_port=args.telemetry_port,
+        updates=args.updates, timeout=args.timeout,
+    )
+    failures += errs
+    if scrapes:
+        status, doc = scrapes[-1]
+        print(
+            f"[slo-smoke] pass: /slo {status} ok={doc.get('ok')} "
+            f"failing={doc.get('failing')} no_data={doc.get('no_data')}",
+            flush=True,
+        )
+        if status != 200 or doc.get("ok") is not True or doc.get("failing"):
+            failures.append(f"pass: /slo not green: {status} {doc}")
+    if exitcode != 0:
+        failures.append(f"pass: storage exitcode {exitcode}, expected 0")
+    if final_doc is not None and final_doc.get("ok") is not True:
+        failures.append(f"pass: final slo.json not ok: {final_doc}")
+
+    # ---- phase 2: impossible rule + fail_run gate, nonzero exit --------
+    scrapes, exitcode, final_doc, errs = run_phase(
+        "fail", f"{PASSING_SPEC},{IMPOSSIBLE_RULE}", fail_run=True,
+        base_port=args.base_port + 20,
+        telemetry_port=args.telemetry_port + 20,
+        updates=args.updates, timeout=args.timeout,
+    )
+    failures += errs
+    if scrapes:
+        status, doc = scrapes[-1]
+        print(
+            f"[slo-smoke] fail: /slo {status} ok={doc.get('ok')} "
+            f"failing={doc.get('failing')}",
+            flush=True,
+        )
+        if status != 503 or doc.get("ok") is not False:
+            failures.append(f"fail: /slo did not report failing: {status} {doc}")
+    if exitcode == 0:
+        failures.append("fail: storage exited 0 despite a violated SLO")
+    else:
+        print(f"[slo-smoke] fail: storage exitcode {exitcode} (gate fired)",
+              flush=True)
+    if final_doc is not None and final_doc.get("ok") is not False:
+        failures.append(f"fail: final slo.json not failing: {final_doc}")
+
+    if failures:
+        for f in failures:
+            print(f"[slo-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[slo-smoke] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

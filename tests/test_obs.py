@@ -181,13 +181,19 @@ def test_prometheus_exposition_golden():
         'policy_staleness_updates_bucket{host="host0",le="+Inf",pid="1",'
         'role="storage",wid="0"} 2'
     )
-    assert lines[-2] == (
+    assert lines[-3] == (
         'policy_staleness_updates_sum{host="host0",pid="1",role="storage",'
         'wid="0"} 3'
     )
-    assert lines[-1] == (
+    assert lines[-2] == (
         'policy_staleness_updates_count{host="host0",pid="1",role="storage",'
         'wid="0"} 2'
+    )
+    # Pre-interpolated tail quantile: rank 1.98 of 2 falls in the (2, 4]
+    # bucket at frac 0.98 -> 2 * 2**0.98 (geometric interpolation).
+    assert lines[-1] == (
+        'policy_staleness_updates_p99{host="host0",pid="1",role="storage",'
+        'wid="0"} 3.944930817973437'
     )
     # every sample line parses as name{labels} value
     for ln in lines:

@@ -46,8 +46,11 @@ INVENTORY: dict[str, dict[str, frozenset[str]]] = {
         # n_flush_full/n_flush_deadline: serve-thread-owned monotonic
         # counters; the learner loop reads them for telemetry only, where a
         # torn read is a one-snapshot off-by-one, not a correctness hazard.
+        # perf: GIL-atomic reference store at thread start (None until the
+        # PerfTracker exists); the learner's telemetry emit only reads it,
+        # and a pre-capture sighting just exports zero FLOPs for one tick.
         "InferenceService._serve": frozenset(
-            {"_jnp", "error", "n_flush_full", "n_flush_deadline"}
+            {"_jnp", "error", "n_flush_full", "n_flush_deadline", "perf"}
         ),
     },
     "tpu_rl/obs/exporters.py": {

@@ -77,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "hops through shared-memory rings (no sockets), 'auto' "
                    "picks shm only when the peer address is loopback "
                    "(default: tcp)")
+    p.add_argument("--slo-spec", default=None,
+                   help="declarative SLO rules evaluated live, e.g. "
+                   "'p99:inference-rtt<5ms@window=30s,gauge:learner-mfu"
+                   ">0.002,rate:transport-rejected-frames<1/s' "
+                   "(see tpu_rl.obs.slo; served at /slo)")
+    p.add_argument("--slo-fail-run", action="store_true",
+                   help="exit nonzero (storage child) when the final SLO "
+                   "verdict has a hard-failing rule")
     p.add_argument("--chaos-spec", default=None,
                    help="deterministic fault plan, e.g. "
                    "'kill:worker-0-1@t+3s,corrupt:rollout@p=0.01,"
@@ -116,6 +124,10 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["trace_sample_n"] = args.trace_sample_n
     if args.transport is not None:
         overrides["transport"] = args.transport
+    if args.slo_spec is not None:
+        overrides["slo_spec"] = args.slo_spec
+    if args.slo_fail_run:
+        overrides["slo_fail_run"] = True
     if args.chaos_spec is not None:
         overrides["chaos_spec"] = args.chaos_spec
     if args.chaos_seed is not None:

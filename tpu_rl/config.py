@@ -386,6 +386,19 @@ class Config:
     # the Chrome trace export at result_dir/trace.json). The recorder only
     # exists when result_dir is set.
     trace_capacity: int = 4096
+    # Declarative SLO rules evaluated over aggregator snapshots each
+    # telemetry tick, e.g.
+    # "p99:inference-rtt<5ms@window=30s,gauge:learner-mfu>0.002,
+    #  rate:transport-rejected-frames<1/s".
+    # Grammar and semantics: tpu_rl/obs/slo.py. Served at /slo on the
+    # telemetry HTTP port (200 while passing, 503 on a hard failure) and
+    # written to result_dir/slo.json at shutdown. None = no engine
+    # constructed, no per-tick cost.
+    slo_spec: str | None = None
+    # Fail-the-run exit gate: when the final SLO verdict at storage
+    # shutdown has any hard-failing rule, the storage child exits nonzero
+    # so smokes/CI fail loudly instead of averaging over a breached run.
+    slo_fail_run: bool = False
     # Rollout-lineage sampling: every Nth worker tick ships a 28-byte trace
     # context (wid, seq, trace id, send timestamp) as an optional THIRD wire
     # part; each hop (worker, manager, storage, assembler, learner) records
@@ -515,6 +528,12 @@ class Config:
             from tpu_rl.chaos.plan import FaultPlan
 
             FaultPlan.parse(self.chaos_spec)
+        if self.slo_spec:
+            # Same fail-at-load contract as chaos_spec: a typo'd rule dies
+            # here, not silently mid-run. slo.py is stdlib + registry math.
+            from tpu_rl.obs.slo import parse_slo_spec
+
+            parse_slo_spec(self.slo_spec)
         assert 0 <= self.telemetry_port < 65536, self.telemetry_port
         assert self.telemetry_interval_s > 0, self.telemetry_interval_s
         assert self.telemetry_stale_s > 0, self.telemetry_stale_s

@@ -1,5 +1,7 @@
 """Fleet-wide observability: metrics registries, the telemetry aggregator,
-exporters (Prometheus / JSON / tensorboard), and span tracing.
+exporters (Prometheus / JSON / tensorboard), span tracing, the live
+performance plane (MFU/FLOPs/recompiles/device memory + profiler capture),
+and the SLO engine.
 
 See ``docs/ARCHITECTURE.md`` ("Observability") for the data flow.
 """
@@ -21,13 +23,24 @@ from tpu_rl.obs.exporters import (
 )
 from tpu_rl.obs.flightrec import FlightRecorder
 from tpu_rl.obs.merge import merge_result_dir, merge_traces
+from tpu_rl.obs.perf import (
+    PEAK_FLOPS,
+    PerfTracker,
+    ProfilerCapture,
+    device_memory_bytes,
+    device_peak_flops,
+    maybe_perf_tracker,
+    process_self_stats,
+)
 from tpu_rl.obs.registry import (
     HIST_BUCKETS,
     MetricsRegistry,
     PeriodicSnapshot,
     diff_snapshots,
+    hist_quantile,
     merge_snapshots,
 )
+from tpu_rl.obs.slo import SloEngine, SloRule, maybe_slo_engine, parse_slo_spec
 from tpu_rl.obs.trace import TraceRecorder
 
 __all__ = [
@@ -39,17 +52,29 @@ __all__ = [
     "JsonExporter",
     "LEARNER_VERSION_GAUGE",
     "MetricsRegistry",
+    "PEAK_FLOPS",
+    "PerfTracker",
     "PeriodicSnapshot",
+    "ProfilerCapture",
     "STALENESS_HIST",
+    "SloEngine",
+    "SloRule",
     "TelemetryAggregator",
     "TelemetryHTTPServer",
     "TensorboardExporter",
     "TraceRecorder",
+    "device_memory_bytes",
+    "device_peak_flops",
     "diff_snapshots",
+    "hist_quantile",
     "maybe_aggregator",
+    "maybe_perf_tracker",
+    "maybe_slo_engine",
     "merge_result_dir",
     "merge_snapshots",
     "merge_traces",
+    "parse_slo_spec",
+    "process_self_stats",
     "render_healthz",
     "render_prometheus",
 ]

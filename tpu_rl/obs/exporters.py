@@ -27,7 +27,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_rl.obs.aggregator import TelemetryAggregator
-from tpu_rl.obs.registry import HIST_BUCKETS
+from tpu_rl.obs.registry import HIST_BUCKETS, hist_quantile
 
 _NAME_OK = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
 
@@ -106,6 +106,12 @@ def render_prometheus(agg: TelemetryAggregator, now: float | None = None) -> str
             lines.append(f"{pname}_bucket{_labels_str(le)} {count}")
             lines.append(f"{pname}_sum{_labels_str(labels)} {_fmt(total)}")
             lines.append(f"{pname}_count{_labels_str(labels)} {count}")
+            # Pre-interpolated tail quantile (registry.hist_quantile) so
+            # dashboards without PromQL histogram_quantile() — and the bare
+            # curl in the README — still read a p99 directly.
+            p99 = hist_quantile(counts, 0.99)
+            if p99 is not None:
+                lines.append(f"{pname}_p99{_labels_str(labels)} {_fmt(p99)}")
     return "\n".join(lines) + "\n"
 
 
@@ -134,11 +140,15 @@ def render_healthz(
 
 class TelemetryHTTPServer:
     """stdlib HTTP thread serving ``/metrics`` (Prometheus text),
-    ``/healthz`` (JSON liveness) and — when the owner wires a ``tracez``
-    callable — ``/tracez`` (the role's live span ring + clock estimates as
-    JSON). Daemonized: it must never hold the storage process open at
-    shutdown, and :meth:`close` is idempotent and bounded so cluster e2e
-    tests can tear servers down back-to-back without leaking the socket."""
+    ``/healthz`` (JSON liveness) and — when the owner wires the matching
+    callable — ``/tracez`` (the role's live span ring + clock estimates),
+    ``/slo`` (last SLO verdict: 200 while every rule holds, 503 on any hard
+    failure, so probes can alert off the status line alone) and ``/prof?ms=N``
+    (bounded on-demand ``jax.profiler`` capture; an overlapping request is
+    refused with 409). Daemonized: it must never hold the storage process
+    open at shutdown, and :meth:`close` is idempotent and bounded so cluster
+    e2e tests can tear servers down back-to-back without leaking the
+    socket."""
 
     def __init__(
         self,
@@ -146,15 +156,19 @@ class TelemetryHTTPServer:
         port: int,
         host: str = "",
         tracez=None,
+        slo=None,
+        prof=None,
     ):
         self.agg = agg
         self.tracez = tracez  # callable -> JSON-able dict, or None
+        self.slo = slo  # callable -> SLO report dict, or None
+        self.prof = prof  # callable (ms|None) -> (started, path|reason)
 
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?")[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
                     body = render_prometheus(outer.agg).encode()
                     ctype, status = "text/plain; version=0.0.4", 200
@@ -169,6 +183,18 @@ class TelemetryHTTPServer:
                     )
                     body = (json.dumps(payload) + "\n").encode()
                     ctype, status = "application/json", 200
+                elif path == "/slo":
+                    if outer.slo is None:
+                        payload, status = {"error": "no slo rules configured"}, 404
+                    else:
+                        payload = outer.slo()
+                        status = 200 if payload.get("ok", True) else 503
+                    body = (json.dumps(payload, indent=1) + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/prof":
+                    status, payload = outer._handle_prof(query)
+                    body = (json.dumps(payload) + "\n").encode()
+                    ctype = "application/json"
                 else:
                     body, ctype, status = b"not found\n", "text/plain", 404
                 self.send_response(status)
@@ -197,6 +223,24 @@ class TelemetryHTTPServer:
             daemon=True,
         )
         self._thread.start()
+
+    def _handle_prof(self, query: str) -> tuple[int, dict]:
+        if self.prof is None:
+            return 404, {"error": "profiler capture not wired"}
+        ms = None
+        for part in query.split("&"):
+            key, sep, value = part.partition("=")
+            if key == "ms" and sep:
+                try:
+                    ms = int(value)
+                except ValueError:
+                    return 400, {"error": f"bad ms value {value!r}"}
+                if ms <= 0:
+                    return 400, {"error": "ms must be positive"}
+        started, detail = self.prof(ms)
+        if not started:
+            return 409, {"error": detail}
+        return 200, {"started": True, "trace_dir": detail, "ms": ms}
 
     def close(self) -> None:
         """Stop accepting, release the listening socket, reap the serve

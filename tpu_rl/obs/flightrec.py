@@ -40,6 +40,32 @@ import traceback
 # the role object, so the installed recorder is reachable module-globally.
 _CURRENT: "FlightRecorder | None" = None
 
+# Cleanup callbacks that must fire on a fatal exception BEFORE the dump —
+# e.g. ``jax.profiler.stop_trace()`` so an in-flight capture is flushed to
+# disk instead of dying with the process. Kept module-global (like
+# ``_CURRENT``) and run even when no recorder is installed: crash cleanup
+# must not depend on result_dir being set.
+_CRASH_HOOKS: list = []
+
+
+def add_crash_hook(fn) -> None:
+    """Register ``fn()`` to run at crash time (idempotent per callable)."""
+    if fn not in _CRASH_HOOKS:
+        _CRASH_HOOKS.append(fn)
+
+
+def remove_crash_hook(fn) -> None:
+    if fn in _CRASH_HOOKS:
+        _CRASH_HOOKS.remove(fn)
+
+
+def _run_crash_hooks() -> None:
+    for fn in list(_CRASH_HOOKS):
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — cleanup must not mask the crash
+            pass
+
 
 def config_fingerprint(cfg) -> str | None:
     """Stable short hash of a config's JSON-able dict — enough to tell two
@@ -140,8 +166,10 @@ def current() -> FlightRecorder | None:
 
 
 def dump_on_crash(exc: BaseException) -> str | None:
-    """Crash hook for ``utils.errlog.role_entry``: record the fatal error
-    into the installed recorder (if any) and dump it. Never raises."""
+    """Crash hook for ``utils.errlog.role_entry``: run registered cleanup
+    hooks (profiler stop etc.), then record the fatal error into the
+    installed recorder (if any) and dump it. Never raises."""
+    _run_crash_hooks()
     fr = _CURRENT
     if fr is None:
         return None

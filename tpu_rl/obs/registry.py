@@ -81,6 +81,39 @@ class Gauge:
             self.value = float(value)
 
 
+def hist_quantile(counts: list[int] | tuple[int, ...], q: float) -> float | None:
+    """Quantile estimate over a :data:`HIST_BUCKETS`-shaped slot-count list
+    (the wire form snapshots carry), geometric interpolation inside buckets.
+
+    Every bucket spans exactly one octave (``hi = 2 * lo``, including the
+    synthetic ``(2^-15, 2^-14]`` floor for the first slot and the capped
+    ``(2^20, 2^21]`` overflow slot), so the interpolated value is
+    ``lo * 2**frac`` where ``frac`` is the rank's position within the
+    bucket. Log-linear interpolation matches the log-scale layout: the
+    estimate is exact when observations are log-uniform within a bucket and
+    never leaves the bucket's bounds. Returns ``None`` on an empty
+    histogram — callers (SLO engine, exporter) must treat no-data
+    explicitly, not as 0.
+    """
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(1.0, max(0.0, float(q)))
+    rank = q * total  # fractional rank in (0, total]
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            hi = HIST_BUCKETS[i] if i < len(HIST_BUCKETS) else HIST_BUCKETS[-1] * 2.0
+            lo = hi / 2.0
+            frac = (rank - cum) / c
+            return lo * (2.0**frac)
+        cum += c
+    hi = HIST_BUCKETS[-1] * 2.0  # unreachable unless counts drifted negative
+    return hi
+
+
 class Histogram:
     """Fixed-bucket distribution (:data:`HIST_BUCKETS` + overflow slot).
     ``counts`` are per-slot (non-cumulative); the Prometheus exporter
@@ -100,6 +133,12 @@ class Histogram:
             self.counts[bisect_left(HIST_BUCKETS, v)] += 1
             self.sum += v
             self.count += 1
+
+    def quantile(self, q: float) -> float | None:
+        """p50/p90/p99/p999 estimate (see :func:`hist_quantile`)."""
+        with self._lock:
+            counts = list(self.counts)
+        return hist_quantile(counts, q)
 
 
 class MetricsRegistry:
@@ -275,6 +314,14 @@ class PeriodicSnapshot:
         self._clock = clock
         self._last = float("-inf")
         self.n_emitted = 0
+
+    def due(self, now: float | None = None) -> bool:
+        """Would :meth:`maybe_emit` emit right now? Lets roles refresh
+        emit-cadence-only metrics (``/proc/self`` reads, fd counts) just
+        before the snapshot they'll ride, without paying for them every
+        tick."""
+        now = self._clock() if now is None else now
+        return now - self._last >= self.interval_s
 
     def maybe_emit(self, now: float | None = None) -> bool:
         now = self._clock() if now is None else now

@@ -147,6 +147,9 @@ class ColocatedLoop:
         self.aggregator = None
         self._http = None
         self._json_exp = None
+        self._perf = None
+        self._prof = None
+        self._slo = None
         self._setup_telemetry()
 
     # ------------------------------------------------------------ device init
@@ -257,16 +260,30 @@ class ColocatedLoop:
         from tpu_rl.obs import (
             JsonExporter,
             MetricsRegistry,
+            PerfTracker,
+            ProfilerCapture,
             TelemetryAggregator,
             TelemetryHTTPServer,
+            maybe_slo_engine,
         )
 
         self.aggregator = TelemetryAggregator(
             registry=MetricsRegistry(role="colocated"),
             stale_after_s=cfg.telemetry_stale_s,
         )
+        self._perf = PerfTracker()
+        self._slo = maybe_slo_engine(cfg)
+        if cfg.result_dir is not None:
+            self._prof = ProfilerCapture(os.path.join(cfg.result_dir, "prof"))
         if cfg.telemetry_port > 0:
-            self._http = TelemetryHTTPServer(self.aggregator, cfg.telemetry_port)
+            self._http = TelemetryHTTPServer(
+                self.aggregator,
+                cfg.telemetry_port,
+                slo=self._slo.report if self._slo is not None else None,
+                prof=(
+                    self._prof.capture_async if self._prof is not None else None
+                ),
+            )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
                 self.aggregator,
@@ -294,15 +311,55 @@ class ColocatedLoop:
         reg.gauge("colocated-env-steps-per-s").set(tps)
         reg.gauge("colocated-mean-episode-return").set(mean_ret)
         reg.histogram("colocated-scan-chunk-s").observe(chunk_s)
+        if self._perf is not None:
+            # chunk_s is the per-iteration mean measured against a blocking
+            # device_get — exactly the dispatch interval the tracker wants.
+            self._perf.note(chunk_s)
+            reg.gauge("colocated-flops-per-step").set(
+                self._perf.flops_per_call
+            )
+            achieved = self._perf.achieved_flops_per_s()
+            if achieved is not None:
+                reg.gauge("colocated-achieved-flops").set(achieved)
+            mfu = self._perf.mfu()
+            if mfu is not None:
+                reg.gauge("colocated-mfu").set(mfu)
+            reg.counter("colocated-xla-recompiles").set_total(
+                self._perf.recompiles
+            )
+            from tpu_rl.obs.perf import device_memory_bytes, process_self_stats
+
+            in_use, peak = device_memory_bytes()
+            reg.gauge("colocated-device-mem-bytes").set(in_use)
+            reg.gauge("colocated-device-mem-peak-bytes").set(peak)
+            rss, n_fds = process_self_stats()
+            reg.gauge("colocated-rss-bytes").set(rss)
+            reg.gauge("colocated-open-fds").set(float(n_fds))
+        if self._slo is not None:
+            self._slo.evaluate(self.aggregator)
         if self._json_exp is not None:
             self._json_exp.maybe_export()
 
     def close(self) -> None:
         if self._http is not None:
             self._http.close()
+        if self._prof is not None:
+            self._prof.close()
+        if self._slo is not None and self.cfg.result_dir is not None:
+            import json
+
+            with open(
+                os.path.join(self.cfg.result_dir, "slo.json"), "w"
+            ) as f:
+                json.dump(self._slo.report(), f, indent=2)
         if self._json_exp is not None:
             # Force a final write regardless of the exporter's cadence.
             self._json_exp.maybe_export(now=float("inf"))
+
+    @property
+    def slo_failed(self) -> bool:
+        """The ``Config.slo_fail_run`` exit gate for the colocated role."""
+        return self._slo is not None and self._slo.failed
 
     # ---------------------------------------------------------------- run loop
     def _stopping(self) -> bool:
@@ -336,6 +393,12 @@ class ColocatedLoop:
             k_roll, k_train = jax.random.split(
                 jax.random.fold_in(self._k_base, it)
             )
+            if self._perf is not None:
+                # One-time AOT cost analysis (identity no-op afterwards) —
+                # must run before dispatch, while donated buffers are alive.
+                self._perf.capture(
+                    self.program, state, carry, stats, k_roll, k_train
+                )
             state, carry, stats, metrics = self.program(
                 state, carry, stats, k_roll, k_train
             )
@@ -422,3 +485,6 @@ def colocated_main(
         f"{out['transitions_per_s']:,.0f} transitions/s",
         flush=True,
     )
+    if cfg.slo_fail_run and loop.slo_failed:
+        print("[colocated] SLO verdict failing; exiting nonzero", flush=True)
+        raise SystemExit(3)

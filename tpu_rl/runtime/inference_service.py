@@ -113,6 +113,10 @@ class InferenceService:
         self.n_flush_deadline = 0
         self.n_rejected_payload = 0
         self.error: BaseException | None = None
+        # Live perf accounting for the act step (tpu_rl.obs.perf): FLOPs
+        # per flushed batch + recompile watch. Built by the serve thread iff
+        # telemetry is on; the learner's _emit_telemetry reads it.
+        self.perf = None
         self._jnp = None  # bound by the serve thread (deferred jax import)
         # Service-level fault injection (tpu_rl.chaos): stall:inference
         # sleeps before a batch flush, refuse:inference swallows replies so
@@ -195,6 +199,16 @@ class InferenceService:
             )
             with self._lock:
                 params = self._params
+            if getattr(cfg, "telemetry_enabled", False):
+                from tpu_rl.obs.perf import PerfTracker
+
+                self.perf = PerfTracker()
+                # One-time cost analysis at the padded warmup shape — the
+                # only shape the service ever dispatches, so a later cache
+                # miss is a real drift signal (inference-xla-recompiles).
+                self.perf.capture(
+                    step, params, *zeros, jax.random.key(self.seed)
+                )
             jax.block_until_ready(
                 step(params, *zeros, jax.random.key(self.seed))
             )
@@ -357,7 +371,10 @@ class InferenceService:
             self.n_replies += 1
         self.n_batches += 1
         self.timer.record_gauge("inference-batch-size", rows)
-        self.timer.record("inference-step-time", time.perf_counter() - t0)
+        flush_secs = time.perf_counter() - t0
+        self.timer.record("inference-step-time", flush_secs)
+        if self.perf is not None:
+            self.perf.note(flush_secs)
 
 
 class InferenceClient:

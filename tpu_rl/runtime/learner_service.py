@@ -187,6 +187,8 @@ class LearnerService:
         self._publisher: AsyncPublisher | None = None
         self._inference = None  # InferenceService when act_mode="remote"
         self._tracer = None  # TraceRecorder when result_dir is set
+        self._perf = None  # PerfTracker when telemetry is on
+        self._prof_capture = None  # ProfilerCapture when any capture path is
         # Idle-rebroadcast odometer: model publishes fired from the starving
         # branch (no fresh update) so late-joining or restarted workers stop
         # acting on a stale/random policy (chaos-plane hardening).
@@ -373,10 +375,17 @@ class LearnerService:
         # update and opens no extra socket (pinned by tests/test_obs.py).
         telem_reg = telem_pub = None
         telem_last = float("-inf")
+        self._perf = None
         if cfg.telemetry_enabled and self.stat_port is not None:
             from tpu_rl.obs import MetricsRegistry
+            from tpu_rl.obs.perf import PerfTracker
 
             telem_reg = MetricsRegistry(role="learner")
+            # Live performance plane (tpu_rl.obs.perf): FLOPs/MFU from a
+            # one-time AOT cost analysis of train_step, recompile and
+            # device-memory watermarks on the emit cadence. None when
+            # telemetry is off — the hot loop pays one `is None` check.
+            self._perf = PerfTracker()
             # Storage telemetry hop: loopback by construction (learner and
             # storage share the host), so transport="shm"/"auto" routes it
             # through the shm channel instead of a TCP loopback socket.
@@ -398,6 +407,20 @@ class LearnerService:
                 "learner", cfg.result_dir, tracer=self._tracer, cfg=cfg
             )
         tracer = self._tracer
+        # Profiler capture gate (tpu_rl.obs.perf.ProfilerCapture): ONE
+        # serialized gate for the config window below, `kill -USR2 <pid>`
+        # (mirroring the flight recorder's SIGUSR1), and the telemetry
+        # server's /prof?ms=N. Its flight-recorder crash hook guarantees
+        # stop_trace() on fatal exceptions, so the capture meant to explain
+        # a crash is flushed instead of dying with the process.
+        prof_capture = self._prof_capture = None
+        if cfg.profile_dir is not None or cfg.result_dir is not None:
+            from tpu_rl.obs.perf import ProfilerCapture
+
+            prof_capture = self._prof_capture = ProfilerCapture(
+                cfg.profile_dir or os.path.join(cfg.result_dir, "prof")
+            )
+            prof_capture.install_sigusr2()
         # One timed window per DISPATCH; a chained dispatch carries
         # chain x (seq x batch) transitions. Kept on self so harnesses
         # (examples/run_tpu_e2e_learner.py) can read the steady-state
@@ -524,10 +547,20 @@ class LearnerService:
                     continue
                 wait_secs = time.perf_counter() - t_wait
                 batch, feed_secs = item
-                t_step = time.perf_counter()
                 key, sub_key = jax.random.split(key)
+                if self._perf is not None:
+                    # Identity check after the first call; first sight of a
+                    # (re)built train_step runs the one-time cost analysis
+                    # and rebinds the recompile watch — BEFORE dispatch, so
+                    # the donated buffers are still alive to lower against.
+                    self._perf.capture(train_step, state, batch, sub_key)
+                t_step = time.perf_counter()
                 state, metrics = train_step(state, batch, sub_key)
                 step_secs = time.perf_counter() - t_step
+                if self._perf is not None:
+                    # The dispatch critical path (same window as the
+                    # learner-throughput timer) drives achieved FLOPs/s.
+                    self._perf.note(wait_secs + step_secs)
                 if tracer is not None:
                     tracer.add("queue-wait", t_wait, wait_secs)
                     tracer.add("train-step", t_step, step_secs)
@@ -576,13 +609,14 @@ class LearnerService:
 
                 if cfg.profile_dir is not None:
                     # Window is relative to THIS run's updates (resume-safe).
+                    # start() returns None when a /prof or SIGUSR2 capture
+                    # is already in flight — the window then simply skips.
                     rel = idx - start_idx
                     if not profiling and rel >= cfg.profile_start:
-                        jax.profiler.start_trace(cfg.profile_dir)
-                        profiling = True
+                        profiling = prof_capture.start() is not None
                     elif profiling and rel >= cfg.profile_start + cfg.profile_steps:
                         jax.block_until_ready(metrics)
-                        jax.profiler.stop_trace()
+                        prof_capture.stop()
                         profiling = False
                 if _crossed(prev_idx, idx, self.publish_interval):
                     self._publish(pub, state, ver=idx)
@@ -640,9 +674,11 @@ class LearnerService:
             feed.close()
             if self._publisher is not None:
                 self._publisher.close()
-            if profiling:
-                # Never leave a trace open (early exit / stop-event / crash).
-                jax.profiler.stop_trace()
+            if prof_capture is not None:
+                # Never leave a trace open (early exit / stop-event / crash)
+                # and unhook from the crash path; idempotent with the
+                # flight-recorder hook that covers non-finally death.
+                prof_capture.close()
             if ckpt is not None:
                 if idx > start_idx:
                     ckpt.save(state, idx, meta=_ckpt_meta())
@@ -883,6 +919,37 @@ class LearnerService:
         reg.counter("learner-rebroadcasts").set_total(self.n_rebroadcasts)
         reg.gauge("learner-run-epoch").set(self.run_epoch)
         reg.counter("learner-join-pushes").set_total(self.n_join_pushes)
+        perf = self._perf
+        if perf is not None:
+            # Performance plane: analytical FLOPs per dispatch, achieved
+            # FLOPs/s over the dispatch window, MFU (omitted when the
+            # device has no peak entry — CPU runs without
+            # TPU_RL_PEAK_FLOPS), shape-drift retraces, and device-memory
+            # watermarks. All refreshed on the emit cadence only.
+            from tpu_rl.obs.perf import device_memory_bytes, process_self_stats
+
+            reg.gauge("learner-flops-per-step").set(perf.flops_per_call)
+            achieved = perf.achieved_flops_per_s()
+            if achieved is not None:
+                reg.gauge("learner-achieved-flops").set(achieved)
+            mfu = perf.mfu()
+            if mfu is not None:
+                reg.gauge("learner-mfu").set(mfu)
+            reg.counter("learner-xla-recompiles").set_total(perf.recompiles)
+            mem_used, mem_peak = device_memory_bytes(self._device)
+            reg.gauge("learner-device-mem-bytes").set(mem_used)
+            reg.gauge("learner-device-mem-peak-bytes").set(mem_peak)
+            rss, n_fds = process_self_stats()
+            reg.gauge("learner-rss-bytes").set(rss)
+            reg.gauge("learner-open-fds").set(n_fds)
+        sa = self.stat_array
+        if sa is not None and len(sa) > SLOT_MODEL_LOADS:
+            # Fleet-total corrupt-frame counter (the mailbox aggregate the
+            # timer gauge above also mirrors) as a true counter, so SLO
+            # `rate:` rules can differentiate it.
+            reg.counter("transport-rejected-frames").set_total(
+                float(sa[SLOT_REJECTED])
+            )
         if self._ckpt is not None:
             reg.gauge("learner-ckpt-pending").set(float(self._ckpt.pending))
             reg.counter("learner-ckpt-saves").set_total(self._ckpt.n_saves)
@@ -897,6 +964,16 @@ class LearnerService:
                 )
                 reg.counter("inference-chaos-refusals").set_total(
                     svc.chaos.n_refused
+                )
+            if svc.perf is not None:
+                reg.gauge("inference-flops-per-step").set(
+                    svc.perf.flops_per_call
+                )
+                achieved = svc.perf.achieved_flops_per_s()
+                if achieved is not None:
+                    reg.gauge("inference-achieved-flops").set(achieved)
+                reg.counter("inference-xla-recompiles").set_total(
+                    svc.perf.recompiles
                 )
         snap = reg.snapshot()
         # Top-level epoch echo (same convention as workers): storage

@@ -108,6 +108,7 @@ class Manager:
         registry = emitter = None
         if self.cfg.telemetry_enabled:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+            from tpu_rl.obs.perf import process_self_stats
 
             registry = MetricsRegistry(role="manager")
 
@@ -183,6 +184,12 @@ class Manager:
                         registry.counter(
                             "chaos-delayed-frames"
                         ).set_total(chaos.n_delayed)
+                    if emitter.due():
+                        # /proc self-stats refreshed only just before an
+                        # emit (syscalls; the gauges only travel then).
+                        rss, n_fds = process_self_stats()
+                        registry.gauge("manager-rss-bytes").set(rss)
+                        registry.gauge("manager-open-fds").set(float(n_fds))
                     if emitter.maybe_emit() and self._tracer is not None:
                         # Trace dumps ride the telemetry cadence so a recent
                         # ring is always on disk for the merger.

@@ -124,6 +124,15 @@ class LearnerStorage:
         self._http = None
         self._json_exp = None
         self._tb_exp = None
+        # SLO engine (tpu_rl.obs.slo): storage owns fleet-wide evaluation —
+        # it already aggregates every role's snapshots. Evaluated on a 1s
+        # cadence (not per frame); /slo serves the last verdict. None unless
+        # Config.slo_spec is set.
+        self._slo = None
+        self._next_slo = 0.0
+        # On-demand profiler captures (/prof?ms=N) for THIS process; the
+        # flight-recorder crash hook guarantees stop_trace on fatal exits.
+        self._prof = None
         # Rollout-lineage tracing (tpu_rl.obs): the storage edge records the
         # ingest + window-close hops for sampled frames, estimates every
         # remote source's clock offset from telemetry echoes, and auto-
@@ -251,9 +260,11 @@ class LearnerStorage:
         from tpu_rl.obs import (
             JsonExporter,
             MetricsRegistry,
+            ProfilerCapture,
             TelemetryAggregator,
             TelemetryHTTPServer,
             TensorboardExporter,
+            maybe_slo_engine,
         )
         from tpu_rl.utils.metrics import NullWriter, make_writer
 
@@ -261,9 +272,18 @@ class LearnerStorage:
             registry=MetricsRegistry(role="storage"),
             stale_after_s=cfg.telemetry_stale_s,
         )
+        self._slo = maybe_slo_engine(cfg)
+        if cfg.result_dir is not None:
+            self._prof = ProfilerCapture(os.path.join(cfg.result_dir, "prof"))
         if cfg.telemetry_port > 0:
             self._http = TelemetryHTTPServer(
-                self.aggregator, cfg.telemetry_port, tracez=self._tracez
+                self.aggregator,
+                cfg.telemetry_port,
+                tracez=self._tracez,
+                slo=self._slo.report if self._slo is not None else None,
+                prof=(
+                    self._prof.capture_async if self._prof is not None else None
+                ),
             )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
@@ -309,6 +329,18 @@ class LearnerStorage:
             reg.counter("chaos-delayed-frames").set_total(
                 self._chaos.n_delayed
             )
+        now_m = time.monotonic()
+        if now_m >= self._next_slo:
+            # 1s cadence for the expensive bits: /proc self-stats and the
+            # fleet-wide SLO pass (the tick itself runs every poll loop).
+            self._next_slo = now_m + 1.0
+            from tpu_rl.obs.perf import process_self_stats
+
+            rss, n_fds = process_self_stats()
+            reg.gauge("storage-rss-bytes").set(rss)
+            reg.gauge("storage-open-fds").set(float(n_fds))
+            if self._slo is not None:
+                self._slo.evaluate(self.aggregator)
         if self._json_exp is not None and self._json_exp.maybe_export():
             if self._tb_exp is not None:
                 self._tb_exp.export(self.aggregator)
@@ -323,11 +355,29 @@ class LearnerStorage:
     def _close_telemetry(self) -> None:
         if self._http is not None:
             self._http.close()
+        if self._prof is not None:
+            self._prof.close()
+        if self._slo is not None:
+            # Final pass so the written verdict covers the run's last data.
+            self._slo.evaluate(self.aggregator)
+            if self.cfg.result_dir is not None:
+                import json
+
+                with open(
+                    os.path.join(self.cfg.result_dir, "slo.json"), "w"
+                ) as f:
+                    json.dump(self._slo.report(), f, indent=2)
         if self._json_exp is not None:
             self._json_exp.maybe_export(now=float("inf"))  # final snapshot
         if self._tb_exp is not None:
             self._tb_exp.export(self.aggregator)
             self._tb_exp.close()
+
+    @property
+    def slo_failed(self) -> bool:
+        """The ``Config.slo_fail_run`` exit gate: True when the final SLO
+        verdict has a hard-failing rule."""
+        return self._slo is not None and self._slo.failed
 
     def _ingest(
         self, proto: Protocol, payload, assembler, trailer: bytes | None = None
@@ -551,6 +601,10 @@ def storage_main(
     heartbeat,
 ) -> None:
     """mp.Process target (reference ``storage_run``, ``main.py:164-187``)."""
-    LearnerStorage(
+    storage = LearnerStorage(
         cfg, handles, learner_port, stat_array, stop_event, heartbeat
-    ).run()
+    )
+    storage.run()
+    if cfg.slo_fail_run and storage.slo_failed:
+        print("[storage] SLO verdict failing; exiting nonzero", flush=True)
+        raise SystemExit(3)

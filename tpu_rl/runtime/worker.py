@@ -178,6 +178,7 @@ class Worker:
         run_epoch = -1
         if cfg.telemetry_enabled:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+            from tpu_rl.obs.perf import process_self_stats
 
             registry = MetricsRegistry(
                 role="worker", labels={"wid": str(self.worker_id)}
@@ -302,7 +303,17 @@ class Worker:
                             if isinstance(t_tx, int):
                                 clk_echo = [t_tx, time.time_ns()]
 
-                reply = remote.act(obs, is_fir) if remote is not None else None
+                if remote is not None:
+                    t_rtt = time.perf_counter()
+                    reply = remote.act(obs, is_fir)
+                    if reply is not None and registry is not None:
+                        # Worker-observed round trip through the inference
+                        # service — the p99 the SLO examples budget against.
+                        registry.histogram("inference-rtt").observe(
+                            time.perf_counter() - t_rtt
+                        )
+                else:
+                    reply = None
                 if remote is not None and reply is None:
                     # Fault path: the service timed out through every retry.
                     # Log once per fallback, drop to local acting on the
@@ -516,6 +527,12 @@ class Worker:
                         registry.counter(
                             "chaos-delayed-frames"
                         ).set_total(chaos.n_delayed)
+                    if emitter.due():
+                        # /proc self-stats only just before an emit — the
+                        # reads cost syscalls, the gauges only travel then.
+                        rss, n_fds = process_self_stats()
+                        registry.gauge("worker-rss-bytes").set(rss)
+                        registry.gauge("worker-open-fds").set(float(n_fds))
                     if emitter.maybe_emit() and tracer is not None:
                         # Trace dumps ride the telemetry cadence: no clock
                         # of their own, and a crash between dumps still
