@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
-	resume-smoke slo-smoke ci
+	resume-smoke slo-smoke loadgen-smoke ci
 
 lint:
 	ruff check .
@@ -103,5 +103,13 @@ resume-smoke:
 slo-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/slo_smoke.py
 
+# Load-plane smoke: a real two-replica inference fleet under a >=10k-client
+# open-loop sweep with a SIGKILL of replica 1 mid-sweep — asserts >=99.9%
+# success via hedged failover, a green sub-saturation p99:inference-rtt
+# verdict, and a monotonic version floor (curve at <tmp>/loadgen.json).
+loadgen-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/loadgen_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
-	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke
+	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
+	loadgen-smoke

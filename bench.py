@@ -707,7 +707,11 @@ def run_act_compare(
     usually loses; the number that matters for the SEED thesis is the
     server-side step time vs batch size (device amortization) and the RTT
     breakdown this emits — on a TPU deployment the same wire cost buys
-    accelerator-grade acting for the whole fleet."""
+    accelerator-grade acting for the whole fleet.
+
+    Also emits fleet rows: the identical client load spread over a
+    two-replica elastic fleet via ``FleetClient`` (p2c routing), hedge-off
+    vs hedged, quantifying the scale-out win and the hedging premium."""
     import threading
 
     from tpu_rl.config import Config
@@ -812,6 +816,80 @@ def run_act_compare(
         }
     finally:
         svc.close()
+
+    # Fleet rows: the same client threads through the elastic-fleet path —
+    # two continuous-batching replicas behind the power-of-two-choices
+    # ``FleetClient``, once with hedging off (pure p2c routing) and once
+    # with an aggressive hedge so the duplicate-send cost is visible. The
+    # delta between ``fleet2_remote_acts_per_s`` and ``remote_acts_per_s``
+    # is what a second replica buys on one host; ``fleet_hedge_overhead``
+    # is the tail-latency insurance premium.
+    from tpu_rl.fleet import FleetClient, InferenceReplica
+
+    def _fleet_run(hedge_ms: int, base: int) -> tuple[float, int, int, int]:
+        fcfg = cfg.replace(inference_hedge_ms=hedge_ms)
+        svcs = [
+            InferenceReplica(fcfg, family, params, port=base + i, seed=i)
+            .start()
+            for i in range(2)
+        ]
+        try:
+            for s in svcs:
+                assert s.wait_ready(300.0) and s.error is None, s.error
+            endpoints = [("127.0.0.1", base + i) for i in range(2)]
+            barrier = threading.Barrier(clients + 1)
+            fails = [0] * clients
+            hedges = [0] * clients
+            dedups = [0] * clients
+
+            def drive(k: int) -> None:
+                cl = FleetClient(fcfg, endpoints, wid=k)
+                try:
+                    rng = np.random.default_rng(k)
+                    obs = rng.standard_normal(
+                        (envs_per_client, int(cfg.obs_shape[0]))
+                    ).astype(np.float32)
+                    first = np.ones(envs_per_client, np.float32)
+                    cl.act(obs, first)  # join + prime outside timed region
+                    barrier.wait()
+                    first = np.zeros(envs_per_client, np.float32)
+                    for _ in range(acts):
+                        if cl.act(obs, first) is None:
+                            fails[k] += 1
+                    hedges[k] = cl.n_hedges
+                    dedups[k] = cl.n_dedups
+                finally:
+                    cl.close()
+
+            threads = [
+                threading.Thread(target=drive, args=(k,), daemon=True)
+                for k in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            aps = clients * acts * envs_per_client / dt
+            return aps, sum(hedges), sum(dedups), sum(fails)
+        finally:
+            for s in svcs:
+                s.close()
+
+    fleet_aps, _, _, fleet_fails = _fleet_run(0, port + 2)
+    hedged_aps, n_hedges, n_dedups, hedged_fails = _fleet_run(1, port + 4)
+    result.update(
+        fleet_replicas=2,
+        fleet2_remote_acts_per_s=round(fleet_aps, 1),
+        fleet2_vs_remote=round(fleet_aps / remote_aps, 3),
+        fleet_hedged_acts_per_s=round(hedged_aps, 1),
+        fleet_hedge_overhead=round(1.0 - hedged_aps / fleet_aps, 3),
+        fleet_hedges_fired=n_hedges,
+        fleet_dedup_replies=n_dedups,
+        fleet_client_failures=fleet_fails + hedged_fails,
+    )
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result), file=sys.stderr, flush=True)

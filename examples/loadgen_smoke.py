@@ -1,0 +1,188 @@
+"""Load-plane smoke: a real two-replica inference fleet under a synthetic
+10k-client open-loop sweep, with a SIGKILL of one replica mid-sweep — the
+CPU-scale proof of ISSUE 12's acceptance bar:
+
+- two ``replica_main`` processes (continuous batching, ver-keyed swaps fed
+  by a live model PUB publishing rising versions) serve the checked
+  ``inference_base_port`` range;
+- ``run_loadgen`` sweeps three offered-load plateaus from 2 driver
+  processes standing in for >= 10k synthetic clients, grading each stage
+  through a fresh SLO engine and writing ``<result-dir>/loadgen.json``;
+- one replica is SIGKILL'd mid-sweep: hedged retries absorb the loss,
+  overall success must stay >= 99.9%, and the per-stage version floor must
+  never decrease (the fleet's monotonic-weights guarantee under churn);
+- the sub-saturation first stage must grade GREEN on
+  ``p99:inference-rtt``.
+
+Exits nonzero on any failure — this is the ``make loadgen-smoke`` CI gate.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/loadgen_smoke.py \
+      [--clients 12000] [--base-port 31400] [--kill-at 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SLO_SPEC = "p99:inference-rtt<250ms@window=60s"
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=12_000)
+    p.add_argument("--base-port", type=int, default=31400)
+    p.add_argument("--rates", default="100,250,600",
+                   help="aggregate offered rps per stage")
+    p.add_argument("--duration", type=float, default=6.0)
+    p.add_argument("--kill-at", type=float, default=8.0,
+                   help="seconds into the sweep the replica-1 SIGKILL fires")
+    p.add_argument("--result-dir", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    from tpu_rl.config import Config
+    from tpu_rl.fleet import replica_main
+    from tpu_rl.loadgen import probe_ready, run_loadgen
+    from tpu_rl.models.families import build_family
+    from tpu_rl.runtime.protocol import Protocol
+    from tpu_rl.runtime.transport import MODEL_HWM, Pub
+
+    model_port = args.base_port + 10
+    cfg = Config.from_dict(dict(
+        algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=32,
+        worker_num_envs=1, act_mode="remote",
+        inference_replicas=2, inference_base_port=args.base_port,
+        inference_batch=16, inference_flush_us=500,
+        inference_timeout_ms=1500, inference_hedge_ms=150,
+        inference_retries=1,
+    ))
+    ports = [args.base_port, args.base_port + 1]
+    endpoints = [("127.0.0.1", prt) for prt in ports]
+    result_dir = args.result_dir or tempfile.mkdtemp(prefix="loadgen-smoke-")
+    out_path = os.path.join(result_dir, "loadgen.json")
+    rates = [float(r) for r in args.rates.split(",")]
+
+    # The stand-in learner: a live model PUB bumping the policy version
+    # every second, so the sweep exercises the replicas' ver-keyed swaps
+    # and the drivers' floor ratchet with real rollout churn.
+    family = build_family(cfg)
+    params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+    actor_host = jax.device_get(params["actor"])
+    pub = Pub("*", model_port, bind=True, hwm=MODEL_HWM)
+    stop_pub = threading.Event()
+
+    def _publish() -> None:
+        ver = 0
+        while not stop_pub.is_set():
+            ver += 1
+            pub.send(Protocol.Model, {"actor": actor_host, "ver": ver})
+            stop_pub.wait(2.0)
+
+    ctx = mp.get_context("spawn")
+    replicas = [
+        ctx.Process(
+            target=replica_main,
+            args=(cfg, i, ports[i], "127.0.0.1", model_port,
+                  cfg.telemetry_port or args.base_port + 11, None, None),
+            kwargs={"seed": 0},
+            daemon=True,
+        )
+        for i in range(2)
+    ]
+    killer = None
+    try:
+        for proc in replicas:
+            proc.start()
+        print(f"[loadgen] fleet booting on {ports} ...", flush=True)
+        if not probe_ready(endpoints, cfg, timeout_s=180.0):
+            print("[loadgen] FAIL: fleet never became ready", flush=True)
+            return 1
+        threading.Thread(target=_publish, daemon=True).start()
+
+        # The chaos leg: replica 1 dies -9 mid-sweep (stage 2 at the
+        # defaults). No respawn — the surviving replica must carry the
+        # offered load through hedged failover.
+        killer = threading.Timer(args.kill_at, replicas[1].kill)
+        killer.daemon = True
+        killer.start()
+
+        print(
+            f"[loadgen] sweep: {args.clients} clients, rates {rates} rps, "
+            f"kill replica-1 at t+{args.kill_at}s", flush=True,
+        )
+        doc = run_loadgen(
+            cfg, endpoints, n_clients=args.clients, rates=rates,
+            duration_s=args.duration, out_path=out_path, n_procs=2,
+            rows=1, slo_spec=SLO_SPEC,
+        )
+    finally:
+        if killer is not None:
+            killer.cancel()
+        stop_pub.set()
+        pub.close()
+        for proc in replicas:
+            if proc.is_alive():
+                proc.kill()
+            proc.join(timeout=10)
+
+    for stage in doc["stages"]:
+        print(json.dumps(stage), flush=True)
+
+    failures = []
+    if not os.path.exists(out_path):
+        failures.append(f"{out_path} was never written")
+    if len(doc["stages"]) != len(rates):
+        failures.append(
+            f"expected {len(rates)} stages, got {len(doc['stages'])}"
+        )
+    success = doc["overall"]["success_rate"]
+    if success < 0.999:
+        failures.append(
+            f"overall success {success} < 0.999 — the kill was not absorbed"
+        )
+    floors = [s["version_floor"] for s in doc["stages"]]
+    if any(b < a for a, b in zip(floors, floors[1:])):
+        failures.append(f"version floor regressed across stages: {floors}")
+    if floors and floors[-1] < 1:
+        failures.append(
+            f"floor never rose ({floors}) — the model broadcast never landed"
+        )
+    first_slo = doc["stages"][0].get("slo") if doc["stages"] else None
+    if not (first_slo and first_slo["ok"]):
+        failures.append(
+            f"sub-saturation stage SLO not green: {first_slo}"
+        )
+    absorbed = sum(
+        s["hedges"] + s["failovers"] for s in doc["stages"][1:]
+    )
+    if absorbed == 0:
+        failures.append(
+            "no hedges/failovers after the kill — the chaos leg never bit"
+        )
+
+    if failures:
+        for f in failures:
+            print(f"[loadgen] FAIL: {f}", flush=True)
+        return 1
+    print(
+        f"[loadgen] OK: {doc['overall']['ok']}/{doc['overall']['sent']} "
+        f"ok ({success:.4%}), floors {floors}, "
+        f"{absorbed} hedged/failed-over after the kill, "
+        f"curve at {out_path}", flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="env-batch size for colocated mode (overrides "
                    "batch_size there; 0/unset = batch_size)")
     p.add_argument("--mesh-data", type=int, help="learner data-mesh size")
+    p.add_argument("--inference-replicas", type=int, default=None,
+                   help="inference fleet size for act_mode=remote: replica 0 "
+                   "serves in-process in the learner, replicas 1..N-1 are "
+                   "supervised children fed by the model broadcast "
+                   "(default 1 = the single in-learner service)")
+    p.add_argument("--inference-base-port", type=int, default=None,
+                   help="first port of the fleet's consecutive replica port "
+                   "range, collision-checked against the learner/model/"
+                   "telemetry/manager ports (0/unset = learner_port + 2)")
+    p.add_argument("--inference-hedge-ms", type=int, default=None,
+                   help="resend an unanswered inference request to a second "
+                   "replica after this many ms (0/unset = hedge only at the "
+                   "full timeout boundary — plain failover)")
+    p.add_argument("--inference-mesh-data", type=int, default=None,
+                   help="GSPMD data-mesh size each inference replica shards "
+                   "its act batch over (1/unset = single-device)")
     p.add_argument("--max-updates", type=int, default=None)
     p.add_argument("--publish-interval", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
@@ -118,6 +134,14 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["colocated_envs"] = args.colocated_envs
     if args.mesh_data:
         overrides["mesh_data"] = args.mesh_data
+    if args.inference_replicas is not None:
+        overrides["inference_replicas"] = args.inference_replicas
+    if args.inference_base_port is not None:
+        overrides["inference_base_port"] = args.inference_base_port
+    if args.inference_hedge_ms is not None:
+        overrides["inference_hedge_ms"] = args.inference_hedge_ms
+    if args.inference_mesh_data is not None:
+        overrides["inference_mesh_data"] = args.inference_mesh_data
     if args.telemetry_port is not None:
         overrides["telemetry_port"] = args.telemetry_port
     if args.trace_sample_n is not None:

@@ -325,6 +325,29 @@ class Config:
     # back once, local forever.
     inference_reprobe_s: float = 5.0
     inference_reprobe_max_s: float = 60.0
+    # ---- inference fleet (tpu_rl.fleet) ----
+    # Number of inference service replicas serving the acting plane
+    # (act_mode="remote"). 1 = the single learner-colocated service (PR 2
+    # semantics). N > 1: replica 0 stays in-process in the learner
+    # (zero-staleness params) and replicas 1..N-1 run as supervised
+    # standalone processes fed by the model broadcast, each a continuous-
+    # batching GSPMD-sharded InferenceReplica; workers act through the
+    # FleetClient (power-of-two selection + hedged retries + failover).
+    inference_replicas: int = 1
+    # First port of the replica port range [base, base + replicas). 0 = the
+    # legacy convention learner_port + 2 (MachinesConfig.inference_ports
+    # still collision-checks the derived range either way).
+    inference_base_port: int = 0
+    # Hedged retries (FleetClient): when a reply hasn't arrived after this
+    # many milliseconds, the SAME request (same seq) is resent to a second
+    # replica and the first reply wins; the duplicate is deduped exactly
+    # once. 0 = hedge only at the full timeout boundary (plain failover).
+    inference_hedge_ms: int = 0
+    # Data-mesh size per inference replica: obs/carry batches are sharded
+    # over `inference_mesh_data` devices (NamedSharding over the "data"
+    # axis, params replicated) and the padded act program runs under GSPMD.
+    # 1 = single-device (no sharding constraints applied).
+    inference_mesh_data: int = 1
     # ---- supervision (tpu_rl.runtime.runner.Supervisor) ----
     # A child silent (no heartbeat) for `heartbeat_timeout_s` is killed and
     # respawned; `startup_grace_s` extends the allowance after (re)spawn so
@@ -506,6 +529,36 @@ class Config:
             f"inference_reprobe_max_s ({self.inference_reprobe_max_s}) must "
             f"be >= inference_reprobe_s ({self.inference_reprobe_s})"
         )
+        assert self.inference_replicas >= 1, self.inference_replicas
+        assert self.inference_hedge_ms >= 0, self.inference_hedge_ms
+        assert self.inference_hedge_ms <= self.inference_timeout_ms, (
+            f"inference_hedge_ms ({self.inference_hedge_ms}) past the "
+            f"request timeout ({self.inference_timeout_ms} ms) can never fire"
+        )
+        assert self.inference_mesh_data >= 1, self.inference_mesh_data
+        if self.inference_base_port:
+            # Explicit replica port range: must fit the port space and must
+            # not collide with the telemetry HTTP port (learner/model/worker
+            # ports live in MachinesConfig — inference_ports() checks those).
+            assert (
+                0 < self.inference_base_port
+                and self.inference_base_port + self.inference_replicas <= 65536
+            ), (
+                f"inference replica ports "
+                f"[{self.inference_base_port}, "
+                f"{self.inference_base_port + self.inference_replicas}) "
+                f"fall outside the port space"
+            )
+            assert not (
+                self.inference_base_port
+                <= self.telemetry_port
+                < self.inference_base_port + self.inference_replicas
+            ), (
+                f"telemetry_port {self.telemetry_port} collides with the "
+                f"inference replica port range "
+                f"[{self.inference_base_port}, "
+                f"{self.inference_base_port + self.inference_replicas})"
+            )
         assert self.heartbeat_timeout_s > 0, self.heartbeat_timeout_s
         assert self.startup_grace_s >= 0, self.startup_grace_s
         assert self.supervise_poll_s > 0, self.supervise_poll_s
@@ -702,6 +755,33 @@ class MachinesConfig:
         """Centralized-inference ROUTER port = learner_port + 2 (the service
         is colocated with the learner, ``runtime/inference_service.py``)."""
         return self.learner_port + 2
+
+    def inference_ports(self, cfg: Config) -> list[int]:
+        """Explicit, collision-checked port allocation for the inference
+        fleet: ``cfg.inference_replicas`` consecutive ports starting at
+        ``cfg.inference_base_port`` (or the legacy ``learner_port + 2``
+        convention when unset). Replaces the silent +2 convention for
+        N-replica fleets — a range that lands on the learner/model/stat
+        ports or any worker manager port fails HERE, at topology load, not
+        as an EADDRINUSE minutes later inside a spawned replica."""
+        base = cfg.inference_base_port or self.inference_port
+        ports = [base + i for i in range(cfg.inference_replicas)]
+        reserved = {
+            self.learner_port: "learner_port (rollout/stat fan-in)",
+            self.model_port: "model_port (weight broadcast)",
+        }
+        if cfg.telemetry_port:
+            reserved[cfg.telemetry_port] = "telemetry_port (HTTP exporter)"
+        for w in self.workers:
+            reserved.setdefault(w.port, "worker manager port")
+        for p in ports:
+            if p in reserved:
+                raise ValueError(
+                    f"inference replica port {p} (range [{base}, "
+                    f"{base + cfg.inference_replicas})) collides with "
+                    f"{reserved[p]}"
+                )
+        return ports
 
 
 def default_result_dirs(base: str = "results") -> tuple[str, str]:

@@ -1,0 +1,34 @@
+"""Sharded elastic inference fleet (new subsystem, ISSUE 12).
+
+Scales the PR 2 single-process :class:`~tpu_rl.runtime.inference_service.
+InferenceService` into N replicas serving the acting plane side by side:
+
+- :mod:`tpu_rl.fleet.replica` — :class:`InferenceReplica`, a continuous-
+  batching, GSPMD-sharded subclass of the inference service with version-
+  keyed (never-rollback) weight swaps, plus ``replica_main``, the supervised
+  standalone-process entry fed by the learner's model broadcast;
+- :mod:`tpu_rl.fleet.client` — :class:`FleetClient`, the worker/loadgen-side
+  replacement for ``InferenceClient``: config-driven replica discovery,
+  power-of-two load-aware selection, hedged retries, failover, and a pinned
+  version floor (a client never accepts weights older than ones it saw);
+- :mod:`tpu_rl.fleet.membership` — :class:`ReplicaTable`, the storage-side
+  lease table for replicas (extends PR 9's ``MembershipTable`` with per-
+  replica version tracking and the fleet-wide monotonic version floor).
+
+Topology: replica 0 stays in-process in the learner (zero-staleness param
+swaps, exactly the PR 2 placement); replicas 1..N-1 are supervisor children
+named ``inference-<i>`` (killable by the chaos plane) that load weights from
+the same model PUB broadcast workers use — the ver-keyed swap makes the
+rollout version-consistent even when broadcasts arrive out of order.
+"""
+
+from tpu_rl.fleet.client import FleetClient
+from tpu_rl.fleet.membership import ReplicaTable
+from tpu_rl.fleet.replica import InferenceReplica, replica_main
+
+__all__ = [
+    "FleetClient",
+    "InferenceReplica",
+    "ReplicaTable",
+    "replica_main",
+]

@@ -1,0 +1,64 @@
+"""Storage-side replica membership: the PR 9 lease table, version-aware.
+
+Inference replicas announce themselves on the existing stat/telemetry
+channel (their snapshots carry a top-level ``rid`` + ``ver``); storage folds
+those frames into a :class:`ReplicaTable` exactly the way worker frames feed
+the worker ``MembershipTable``. The replica-specific additions:
+
+- **per-replica versions**: the newest policy version each replica reported
+  serving, so dashboards can see a replica lagging the rollout;
+- **the fleet version floor**: the highest version ANY replica has ever
+  reported. It is a monotonic ratchet that survives evictions and replica
+  restarts (resume-aware): a killed replica rejoining on random-init weights
+  (ver −1) must not lower the floor clients already observed — the
+  ``FleetClient`` enforces the same floor on its side by discarding replies
+  below it.
+
+A replica JOIN raises the same mailbox flag a worker join does
+(``SLOT_JOIN_REQ``), so the learner's existing join-push path immediately
+re-publishes current weights + ver — the "join-push of current weights" leg
+of the fleet rollout, with zero new wire machinery.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpu_rl.runtime.storage import MembershipTable
+
+
+class ReplicaTable(MembershipTable):
+    """Lease-based live membership of inference replicas, keyed by rid,
+    with per-replica served-version tracking and the fleet-wide monotonic
+    version floor."""
+
+    def __init__(self, lease_s: float, clock=time.monotonic):
+        super().__init__(lease_s, clock)
+        self.versions: dict[int, int] = {}  # rid -> newest reported ver
+        self.floor = -1  # max ver ever reported; never decreases
+
+    def touch(
+        self, rid: int, ver: int = -1, now: float | None = None
+    ) -> bool:
+        """Renew rid's lease and ratchet its version; True iff (re)join."""
+        joined = super().touch(rid, now)
+        if ver > self.versions.get(rid, -1):
+            self.versions[rid] = ver
+            if ver > self.floor:
+                self.floor = ver
+        return joined
+
+    def evict_expired(self, now: float | None = None) -> list[int]:
+        dead = super().evict_expired(now)
+        for rid in dead:
+            # The per-replica row goes; the floor stays — clients may have
+            # observed the dead replica's weights and the fleet guarantee
+            # ("never serve older than seen") outlives any one replica.
+            self.versions.pop(rid, None)
+        return dead
+
+    def min_active_version(self) -> int:
+        """Oldest version among live replicas (−1 when none reported): the
+        worst staleness a load-balanced request can currently land on."""
+        vers = [self.versions.get(rid, -1) for rid in self.active]
+        return min(vers) if vers else -1

@@ -1,0 +1,22 @@
+"""Synthetic load plane for the inference fleet (new subsystem, ISSUE 12).
+
+Spawns tens of thousands of lightweight synthetic clients — request replay
+against the fleet's ObsRequest/Act channel, no env stepping, no jax — from a
+few driver processes, sweeps offered load, and grades the resulting latency
+distributions through the PR 11 SLO engine. The output is a saturation
+curve (``result_dir/loadgen.json``): offered vs achieved rate, success
+rate, rtt quantiles, and hedge/failover/dedup accounting per stage.
+
+A "client" here is a (wid, seq) identity stamped on replayed requests, not
+a socket: one DEALER lane per replica per driver process carries every
+client's traffic, which is what makes 10k+ clients per process feasible.
+The driver mirrors :class:`~tpu_rl.fleet.client.FleetClient` semantics —
+power-of-two lane choice, hedged retries, version-floor pinning — in
+open-loop form (sends on a schedule, never waits for replies), so the
+numbers it produces measure the FLEET, not a closed-loop client's
+self-throttling.
+"""
+
+from tpu_rl.loadgen.driver import LoadDriver, probe_ready, run_loadgen
+
+__all__ = ["LoadDriver", "probe_ready", "run_loadgen"]

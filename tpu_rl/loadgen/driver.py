@@ -1,0 +1,437 @@
+"""Open-loop synthetic load driver + multi-process sweep orchestration.
+
+:class:`LoadDriver` is one driver process's engine: it replays ObsRequest
+frames for ``n_clients`` synthetic client identities over one DEALER lane
+per replica, on an open-loop schedule (a request is sent when the schedule
+says so, regardless of how many are still in flight — the only load shape
+that can actually push a server past saturation). Fleet semantics match
+:class:`~tpu_rl.fleet.client.FleetClient`: power-of-two lane selection,
+hedges after ``Config.inference_hedge_ms``, late/duplicate replies
+discarded + counted, and a pinned monotonic version floor.
+
+:func:`run_loadgen` fans a stage sweep across N driver processes (spawn
+context — parents that imported jax stay safe), merges the per-stage
+telemetry snapshots with the registry's elementwise merge, grades each
+stage through a fresh :class:`~tpu_rl.obs.slo.SloEngine`, and writes the
+saturation curve to ``loadgen.json``.
+
+Numpy + stdlib only: driver processes never import jax, so 10k+ synthetic
+clients cost a few MB, not a few XLA runtimes.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import random
+import time
+import uuid
+
+import numpy as np
+
+from tpu_rl.config import Config
+from tpu_rl.obs.registry import (
+    MetricsRegistry,
+    hist_quantile,
+    merge_snapshots,
+)
+from tpu_rl.runtime.protocol import Protocol
+from tpu_rl.runtime.transport import Dealer
+
+# A lane that times out a request is benched this long before selection
+# considers it again; hedges keep traffic flowing meanwhile. Short on
+# purpose: the loadgen must notice a killed replica fast AND re-admit a
+# recovered one fast, or the saturation curve measures the bench, not the
+# fleet.
+_LANE_DEAD_S = 1.0
+
+
+class _Lane:
+    __slots__ = ("dealer", "ewma_ms", "dead_until")
+
+    def __init__(self, dealer: Dealer):
+        self.dealer = dealer
+        self.ewma_ms = 0.0
+        self.dead_until = 0.0
+
+    def observe(self, rtt_ms: float) -> None:
+        self.ewma_ms = (
+            rtt_ms if self.ewma_ms == 0.0
+            else 0.8 * self.ewma_ms + 0.2 * rtt_ms
+        )
+
+
+class _InFlight:
+    __slots__ = ("t_send", "primary", "hedged")
+
+    def __init__(self, t_send: float, primary: int):
+        self.t_send = t_send
+        self.primary = primary
+        self.hedged = False
+
+
+class LoadDriver:
+    """One process's synthetic clients. ``run_stage`` executes a single
+    offered-load plateau and returns its result row + telemetry snapshot."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        endpoints: list[tuple[str, int]],
+        n_clients: int,
+        obs_dim: int,
+        rows: int = 1,
+        seed: int = 0,
+    ):
+        if not endpoints:
+            raise ValueError("LoadDriver needs at least one endpoint")
+        self.cfg = cfg
+        self.n_clients = int(n_clients)
+        self.seed = seed
+        self._rng = random.Random(0xCAFE ^ (seed * 40503))
+        self.floor = -1
+        self.seq = 0
+        # Request replay: every client sends the same observation frame —
+        # the server's work per request is identical either way, and the
+        # replay buffer is two tiny arrays instead of an env.
+        self._obs = np.zeros((rows, obs_dim), np.float32)
+        self._first = np.ones((rows,), np.float32)
+        self.lanes = [
+            _Lane(Dealer(
+                ip, port,
+                identity=f"lg{seed}-r{i}-{uuid.uuid4().hex[:6]}".encode(),
+            ))
+            for i, (ip, port) in enumerate(endpoints)
+        ]
+
+    # ------------------------------------------------------------- selection
+    def _pick(self, exclude: tuple[int, ...] = ()) -> int | None:
+        now = time.monotonic()
+        live = [
+            i for i, lane in enumerate(self.lanes)
+            if i not in exclude and lane.dead_until <= now
+        ]
+        if not live:
+            # All benched: probe whichever recovers first (never stall the
+            # schedule — open-loop means the load keeps coming).
+            rest = [i for i in range(len(self.lanes)) if i not in exclude]
+            if not rest:
+                return None
+            return min(rest, key=lambda i: self.lanes[i].dead_until)
+        if len(live) == 1:
+            return live[0]
+        a, b = self._rng.sample(live, 2)
+        return a if self.lanes[a].ewma_ms <= self.lanes[b].ewma_ms else b
+
+    def _send(self, lane_idx: int, seq: int) -> None:
+        self.lanes[lane_idx].dealer.send(Protocol.ObsRequest, {
+            "wid": seq % self.n_clients,  # the synthetic client identity
+            "seq": seq,
+            "obs": self._obs,
+            "first": self._first,
+            "floor": self.floor,
+        })
+
+    # ----------------------------------------------------------------- stage
+    def run_stage(self, rate_rps: float, duration_s: float) -> dict:
+        """One plateau of the sweep: offer ``rate_rps`` for ``duration_s``,
+        then drain one timeout window. Returns the stage row with the
+        stage's telemetry snapshot attached under ``"snapshot"``."""
+        cfg = self.cfg
+        registry = MetricsRegistry(
+            role="loadgen", labels={"drv": str(self.seed)}
+        )
+        rtt_hist = registry.histogram("inference-rtt")
+        hedge_s = cfg.inference_hedge_ms / 1e3
+        timeout_s = cfg.inference_timeout_ms / 1e3
+        interval = 1.0 / rate_rps if rate_rps > 0 else float("inf")
+        inflight: dict[int, _InFlight] = {}
+        sent = ok = failed = 0
+        hedges = failovers = dedups = floor_rejects = 0
+
+        start = time.perf_counter()
+        stop_sending = start + duration_s
+        next_send = start
+        hard_stop = stop_sending + timeout_s + hedge_s + 0.5
+
+        while True:
+            now = time.perf_counter()
+            if now >= hard_stop or (now >= stop_sending and not inflight):
+                break
+            # 1) send everything the schedule owes (bounded burst so a long
+            # drain stall doesn't explode into one giant send storm)
+            burst = 0
+            while now < stop_sending and next_send <= now and burst < 256:
+                primary = self._pick()
+                if primary is None:
+                    break
+                self._send(primary, self.seq)
+                inflight[self.seq] = _InFlight(now, primary)
+                self.seq += 1
+                sent += 1
+                burst += 1
+                next_send += interval
+            # 2) drain every lane
+            for idx, lane in enumerate(self.lanes):
+                while True:
+                    got = lane.dealer.recv(timeout_ms=0)
+                    if got is None:
+                        break
+                    proto, payload = got
+                    if proto != Protocol.Act or not isinstance(payload, dict):
+                        continue
+                    seq = payload.get("seq")
+                    entry = inflight.get(seq)
+                    if entry is None:
+                        dedups += 1  # hedge loser / post-timeout straggler
+                        continue
+                    ver = int(payload.get("ver", -1))
+                    if ver < self.floor:
+                        floor_rejects += 1  # keep waiting on this seq
+                        continue
+                    self.floor = max(self.floor, ver)
+                    del inflight[seq]
+                    ok += 1
+                    rtt = time.perf_counter() - entry.t_send
+                    rtt_hist.observe(rtt)
+                    lane.observe(rtt * 1e3)
+                    lane.dead_until = 0.0
+                    if idx != entry.primary:
+                        failovers += 1
+            # 3) hedge + expire
+            now = time.perf_counter()
+            expired = []
+            for seq, entry in inflight.items():
+                age = now - entry.t_send
+                if not entry.hedged and hedge_s > 0 and age >= hedge_s:
+                    alt = self._pick(exclude=(entry.primary,))
+                    if alt is not None:
+                        self._send(alt, seq)
+                        entry.hedged = True
+                        hedges += 1
+                if age >= timeout_s:
+                    expired.append(seq)
+            for seq in expired:
+                entry = inflight.pop(seq)
+                failed += 1
+                self.lanes[entry.primary].dead_until = (
+                    time.monotonic() + _LANE_DEAD_S
+                )
+            time.sleep(0.0005)
+
+        elapsed = time.perf_counter() - start
+        registry.counter("loadgen-requests").inc(sent)
+        registry.counter("loadgen-replies").inc(ok)
+        registry.counter("loadgen-failures").inc(failed + len(inflight))
+        registry.counter("fleet-hedge-fired").inc(hedges)
+        registry.counter("fleet-failovers").inc(failovers)
+        registry.counter("fleet-dedup-replies").inc(dedups)
+        registry.counter("fleet-floor-rejects").inc(floor_rejects)
+        registry.gauge("loadgen-offered-rate").set(rate_rps)
+        registry.gauge("loadgen-achieved-rate").set(
+            ok / elapsed if elapsed > 0 else 0.0
+        )
+        registry.gauge("fleet-version-floor").set(self.floor)
+        failed += len(inflight)  # whatever never resolved by hard_stop
+        return {
+            "offered_rps": rate_rps,
+            "achieved_rps": round(ok / elapsed, 3) if elapsed > 0 else 0.0,
+            "sent": sent,
+            "ok": ok,
+            "failed": failed,
+            "success_rate": round(ok / sent, 6) if sent else 1.0,
+            "hedges": hedges,
+            "failovers": failovers,
+            "dedups": dedups,
+            "floor_rejects": floor_rejects,
+            "version_floor": self.floor,
+            "snapshot": registry.snapshot(),
+        }
+
+    def close(self) -> None:
+        for lane in self.lanes:
+            lane.dealer.close()
+
+
+# ---------------------------------------------------------------- readiness
+def probe_ready(
+    endpoints: list[tuple[str, int]],
+    cfg: Config,
+    timeout_s: float = 60.0,
+    obs_dim: int | None = None,
+) -> bool:
+    """Block until every endpoint answers one probe request (or the deadline
+    lapses). Run before a sweep: a stage measured against a still-compiling
+    replica is a saturation curve of XLA, not of the fleet."""
+    dim = int(cfg.obs_shape[0]) if obs_dim is None else int(obs_dim)
+    obs = np.zeros((1, dim), np.float32)
+    first = np.ones((1,), np.float32)
+    deadline = time.monotonic() + timeout_s
+    for i, (ip, port) in enumerate(endpoints):
+        dealer = Dealer(
+            ip, port, identity=f"probe-{i}-{uuid.uuid4().hex[:6]}".encode()
+        )
+        try:
+            seq = 0
+            while True:
+                if time.monotonic() >= deadline:
+                    return False
+                dealer.send(Protocol.ObsRequest, {
+                    "wid": 0, "seq": seq, "obs": obs, "first": first,
+                })
+                got = dealer.recv(timeout_ms=500)
+                if got is not None and got[0] == Protocol.Act:
+                    break
+                seq += 1
+        finally:
+            dealer.close()
+    return True
+
+
+# -------------------------------------------------------------------- sweep
+def _driver_proc(
+    cfg: Config,
+    endpoints: list[tuple[str, int]],
+    n_clients: int,
+    obs_dim: int,
+    rows: int,
+    seed: int,
+    rates: list[float],
+    duration_s: float,
+    q,
+) -> None:
+    """Spawn-context child: run every stage of the sweep at this process's
+    share of the offered rate, shipping (seed, stage_idx, row) back."""
+    driver = LoadDriver(
+        cfg, endpoints, n_clients, obs_dim, rows=rows, seed=seed
+    )
+    try:
+        for idx, rate in enumerate(rates):
+            q.put((seed, idx, driver.run_stage(rate, duration_s)))
+    finally:
+        driver.close()
+
+
+def run_loadgen(
+    cfg: Config,
+    endpoints: list[tuple[str, int]],
+    n_clients: int,
+    rates: list[float],
+    duration_s: float,
+    out_path: str | None = None,
+    n_procs: int = 1,
+    rows: int = 1,
+    obs_dim: int | None = None,
+    slo_spec: str | None = None,
+) -> dict:
+    """Sweep ``rates`` (aggregate offered rps) across ``n_procs`` driver
+    processes and produce the saturation-curve document.
+
+    Per stage: the drivers' telemetry snapshots merge elementwise (shared
+    HIST_BUCKETS make quantiles exact across processes), rtt quantiles come
+    from the merged histogram, and — when ``slo_spec`` is given — a FRESH
+    SLO engine grades the merged snapshot, so every stage's verdict is
+    independent (a saturated stage must not burn the budget of the
+    sub-saturation stage before it). Writes ``out_path`` (loadgen.json)
+    when given; returns the document either way.
+    """
+    from tpu_rl.obs.slo import SloEngine
+
+    dim = int(cfg.obs_shape[0]) if obs_dim is None else int(obs_dim)
+    n_procs = max(1, int(n_procs))
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = []
+    for p in range(n_procs):
+        share = [r / n_procs for r in rates]
+        procs.append(ctx.Process(
+            target=_driver_proc,
+            args=(cfg, endpoints, max(1, n_clients // n_procs), dim, rows,
+                  p, share, duration_s, q),
+            daemon=True,
+        ))
+    for proc in procs:
+        proc.start()
+    rows_by_stage: dict[int, list[dict]] = {}
+    expect = n_procs * len(rates)
+    budget = (duration_s + cfg.inference_timeout_ms / 1e3 + 30.0) * len(rates)
+    deadline = time.monotonic() + budget
+    got = 0
+    while got < expect and time.monotonic() < deadline:
+        try:
+            _seed, idx, row = q.get(timeout=1.0)
+        except Exception:  # noqa: BLE001 — queue.Empty; re-check deadline
+            if not any(proc.is_alive() for proc in procs):
+                break
+            continue
+        rows_by_stage.setdefault(idx, []).append(row)
+        got += 1
+    for proc in procs:
+        proc.join(timeout=10.0)
+        if proc.is_alive():
+            proc.terminate()
+
+    stages = []
+    tot_sent = tot_ok = 0
+    for idx in sorted(rows_by_stage):
+        per = rows_by_stage[idx]
+        snap = per[0]["snapshot"]
+        for row in per[1:]:
+            snap = merge_snapshots(snap, row["snapshot"])
+        hist = next(
+            (h for h in snap.get("hists", ()) if h[0] == "inference-rtt"),
+            None,
+        )
+        quant = {}
+        if hist is not None:
+            for label, qq in (("p50", 0.5), ("p99", 0.99), ("p999", 0.999)):
+                v = hist_quantile(hist[2], qq)
+                quant[f"{label}_ms"] = (
+                    round(v * 1e3, 3) if v is not None else None
+                )
+        sent = sum(r["sent"] for r in per)
+        okc = sum(r["ok"] for r in per)
+        tot_sent += sent
+        tot_ok += okc
+        stage = {
+            "offered_rps": sum(r["offered_rps"] for r in per),
+            "achieved_rps": round(sum(r["achieved_rps"] for r in per), 3),
+            "sent": sent,
+            "ok": okc,
+            "failed": sum(r["failed"] for r in per),
+            "success_rate": round(okc / sent, 6) if sent else 1.0,
+            "hedges": sum(r["hedges"] for r in per),
+            "failovers": sum(r["failovers"] for r in per),
+            "dedups": sum(r["dedups"] for r in per),
+            "floor_rejects": sum(r["floor_rejects"] for r in per),
+            "version_floor": max(r["version_floor"] for r in per),
+            **quant,
+        }
+        if slo_spec:
+            stage["slo"] = SloEngine(slo_spec).evaluate([snap])
+        stages.append(stage)
+
+    doc = {
+        "n_clients": int(n_clients),
+        "n_procs": n_procs,
+        "rows": int(rows),
+        "duration_s": float(duration_s),
+        "endpoints": [[ip, port] for ip, port in endpoints],
+        "slo_spec": slo_spec,
+        "stages": stages,
+        "overall": {
+            "sent": tot_sent,
+            "ok": tot_ok,
+            "success_rate": (
+                round(tot_ok / tot_sent, 6) if tot_sent else 1.0
+            ),
+        },
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        tmp = f"{out_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, out_path)  # crash-atomic, like every result file
+    return doc

@@ -158,19 +158,38 @@ class InferenceService:
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 
+    @property
+    def version(self) -> int:
+        """Policy version currently served (the update index echoed in every
+        Act reply)."""
+        with self._lock:
+            return self._version
+
     # ----------------------------------------------------------------- serve
     def _serve(self) -> None:
         import jax
         import jax.numpy as jnp
 
         self._jnp = jnp
-        cfg = self.cfg
-        family = self.family
-        act = family.act
-        store_carry = family.store_carry
-        pad_rows = max(cfg.inference_batch, cfg.worker_num_envs)
-        hw, cw = family.carry_widths
-        obs_dim = int(cfg.obs_shape[0])
+        step, pad_rows = self._build_step(jax, jnp)
+        router = None
+        try:
+            self._warm(jax, jnp, step, pad_rows)
+            router = Router(*self.addr, bind=True)
+            key = jax.random.key(self.seed * 7919 + 17)
+            self._ready.set()
+            self._loop(jax, router, step, pad_rows, key)
+        except BaseException as e:  # noqa: BLE001 — surfaced via .error
+            self.error = e
+            self._ready.set()  # never leave wait_ready() hanging
+            raise
+        finally:
+            if router is not None:
+                router.close()
+
+    def _step_fn(self, jnp):
+        """The pure padded act program (shared by every jit variant)."""
+        act = self.family.act
 
         def _step(params, obs, h, c, first, key):
             # Zero the carry rows whose env just reset (server-side episode
@@ -184,95 +203,102 @@ class InferenceService:
             a, logits, log_prob, h2, c2 = act(params, obs, h, c, key)
             return a, logits, log_prob, h, c, h2, c2
 
-        step = jax.jit(_step)
+        return _step
 
-        router = None
-        try:
-            # Compile at the padded shape BEFORE binding the socket: the
-            # first real request must never eat the XLA compile inside the
-            # workers' inference_timeout_ms window.
-            zeros = (
-                jnp.zeros((pad_rows, obs_dim)),
-                jnp.zeros((pad_rows, hw)),
-                jnp.zeros((pad_rows, cw)),
-                jnp.zeros((pad_rows,)),
+    def _build_step(self, jax, jnp):
+        """Jit the padded act program; -> (step, pad_rows). Overridden by
+        the fleet replica (tpu_rl.fleet) to apply GSPMD batch sharding and
+        mesh-divisible padding."""
+        cfg = self.cfg
+        pad_rows = max(cfg.inference_batch, cfg.worker_num_envs)
+        return jax.jit(self._step_fn(jnp)), pad_rows
+
+    def _warm(self, jax, jnp, step, pad_rows) -> None:
+        """Compile at the padded shape BEFORE binding the socket: the first
+        real request must never eat the XLA compile inside the workers'
+        inference_timeout_ms window."""
+        hw, cw = self.family.carry_widths
+        obs_dim = int(self.cfg.obs_shape[0])
+        zeros = (
+            jnp.zeros((pad_rows, obs_dim)),
+            jnp.zeros((pad_rows, hw)),
+            jnp.zeros((pad_rows, cw)),
+            jnp.zeros((pad_rows,)),
+        )
+        with self._lock:
+            params = self._params
+        if getattr(self.cfg, "telemetry_enabled", False):
+            from tpu_rl.obs.perf import PerfTracker
+
+            self.perf = PerfTracker()
+            # One-time cost analysis at the padded warmup shape — the
+            # only shape the service ever dispatches, so a later cache
+            # miss is a real drift signal (inference-xla-recompiles).
+            self.perf.capture(
+                step, params, *zeros, jax.random.key(self.seed)
             )
-            with self._lock:
-                params = self._params
-            if getattr(cfg, "telemetry_enabled", False):
-                from tpu_rl.obs.perf import PerfTracker
+        jax.block_until_ready(
+            step(params, *zeros, jax.random.key(self.seed))
+        )
 
-                self.perf = PerfTracker()
-                # One-time cost analysis at the padded warmup shape — the
-                # only shape the service ever dispatches, so a later cache
-                # miss is a real drift signal (inference-xla-recompiles).
-                self.perf.capture(
-                    step, params, *zeros, jax.random.key(self.seed)
-                )
-            jax.block_until_ready(
-                step(params, *zeros, jax.random.key(self.seed))
-            )
+    def _loop(self, jax, router, step, pad_rows, key) -> None:
+        """Max-batch-or-deadline dynamic batching (the PR 2 semantics): a
+        flush dispatches when ``inference_batch`` rows are pending or the
+        oldest request is ``inference_flush_us`` old. The fleet replica
+        overrides this with continuous batching."""
+        cfg = self.cfg
+        jnp = self._jnp
+        store_carry = self.family.store_carry
+        pending: list[_Pending] = []
+        pending_rows = 0
+        flush_s = cfg.inference_flush_us / 1e6
 
-            router = Router(*self.addr, bind=True)
-            key = jax.random.key(self.seed * 7919 + 17)
-            pending: list[_Pending] = []
-            pending_rows = 0
-            flush_s = cfg.inference_flush_us / 1e6
-            self._ready.set()
-
-            while not self._stop.is_set():
-                # Bounded poll: until the flush deadline when requests are
-                # pending, a housekeeping tick otherwise.
-                if pending:
-                    budget = flush_s - (time.perf_counter() - pending[0].arrived)
-                    timeout_ms = max(0, int(budget * 1e3))
-                else:
-                    timeout_ms = 20
-                got = router.recv(timeout_ms=timeout_ms)
-                if got is not None:
-                    req = self._ingest(*got)
+        while not self._stop.is_set():
+            # Bounded poll: until the flush deadline when requests are
+            # pending, a housekeeping tick otherwise.
+            if pending:
+                budget = flush_s - (time.perf_counter() - pending[0].arrived)
+                timeout_ms = max(0, int(budget * 1e3))
+            else:
+                timeout_ms = 20
+            got = router.recv(timeout_ms=timeout_ms)
+            if got is not None:
+                req = self._ingest(*got)
+                if req is not None:
+                    pending.append(req)
+                    pending_rows += req.obs.shape[0]
+                for parts in router.drain():
+                    req = self._ingest(*parts)
                     if req is not None:
                         pending.append(req)
                         pending_rows += req.obs.shape[0]
-                    for parts in router.drain():
-                        req = self._ingest(*parts)
-                        if req is not None:
-                            pending.append(req)
-                            pending_rows += req.obs.shape[0]
-                if not pending:
-                    continue
-                full = pending_rows >= cfg.inference_batch
-                expired = (
-                    time.perf_counter() - pending[0].arrived >= flush_s
+            if not pending:
+                continue
+            full = pending_rows >= cfg.inference_batch
+            expired = (
+                time.perf_counter() - pending[0].arrived >= flush_s
+            )
+            if not (full or expired):
+                continue
+            self.n_flush_full += 1 if full else 0
+            self.n_flush_deadline += 0 if full else 1
+            # Flush whole-client chunks of at most pad_rows rows; a
+            # burst larger than one padded program drains over several
+            # back-to-back dispatches.
+            while pending:
+                chunk, rows = [], 0
+                while pending and rows + pending[0].obs.shape[0] <= pad_rows:
+                    req = pending.pop(0)
+                    chunk.append(req)
+                    rows += req.obs.shape[0]
+                pending_rows -= rows
+                key, sub = jax.random.split(key)
+                self._flush(
+                    router, step, chunk, rows, pad_rows, sub,
+                    store_carry, jnp,
                 )
-                if not (full or expired):
-                    continue
-                self.n_flush_full += 1 if full else 0
-                self.n_flush_deadline += 0 if full else 1
-                # Flush whole-client chunks of at most pad_rows rows; a
-                # burst larger than one padded program drains over several
-                # back-to-back dispatches.
-                while pending:
-                    chunk, rows = [], 0
-                    while pending and rows + pending[0].obs.shape[0] <= pad_rows:
-                        req = pending.pop(0)
-                        chunk.append(req)
-                        rows += req.obs.shape[0]
-                    pending_rows -= rows
-                    key, sub = jax.random.split(key)
-                    self._flush(
-                        router, step, chunk, rows, pad_rows, sub,
-                        store_carry, jnp,
-                    )
-                    if rows < cfg.inference_batch:
-                        break  # partial tail came from the deadline, done
-        except BaseException as e:  # noqa: BLE001 — surfaced via .error
-            self.error = e
-            self._ready.set()  # never leave wait_ready() hanging
-            raise
-        finally:
-            if router is not None:
-                router.close()
+                if rows < cfg.inference_batch:
+                    break  # partial tail came from the deadline, done
 
     # ---------------------------------------------------------------- ingest
     def _ingest(self, identity: bytes, proto: Protocol, payload

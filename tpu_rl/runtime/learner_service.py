@@ -461,7 +461,15 @@ class LearnerService:
         # shares `timer`, so inference-batch-size / inference-step-time land
         # on the learner's tensorboard alongside the hot-loop timings.
         if cfg.act_mode == "remote" and self.inference_port is not None:
-            from tpu_rl.runtime.inference_service import InferenceService
+            if cfg.inference_replicas > 1:
+                # Fleet mode: the in-learner service is replica 0 —
+                # continuous batching + the ver-keyed swap, so its replies
+                # respect the same version monotonicity the standalone
+                # replicas give (learner versions only ever rise, so every
+                # in-process swap applies).
+                from tpu_rl.fleet import InferenceReplica as InferenceService
+            else:
+                from tpu_rl.runtime.inference_service import InferenceService
 
             self._inference = InferenceService(
                 cfg,

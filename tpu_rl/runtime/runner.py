@@ -391,6 +391,12 @@ def learner_role(
     sup.spawn(
         "storage", storage_main, cfg, handles, machines.learner_port, stat_array
     )
+    # Inference fleet port plan (collision-checked): replica 0 lives inside
+    # the learner process (zero-staleness swaps); replicas 1..N-1 are
+    # supervised children below.
+    inference_ports = (
+        machines.inference_ports(cfg) if cfg.act_mode == "remote" else None
+    )
     sup.spawn(
         "learner",
         functools.partial(
@@ -401,7 +407,7 @@ def learner_role(
             # The centralized-inference ROUTER (act_mode="remote") binds in
             # the learner process; the service itself gates on act_mode.
             inference_port=(
-                machines.inference_port if cfg.act_mode == "remote" else None
+                inference_ports[0] if inference_ports is not None else None
             ),
             # The stat channel storage SUB-binds: the learner's Telemetry
             # snapshots ship there (LearnerService gates on telemetry_enabled).
@@ -415,6 +421,23 @@ def learner_role(
         # backend (CI, or when another process holds the chip).
         cpu_only=(cfg.learner_device == "cpu"),
     )
+    if inference_ports is not None and cfg.inference_replicas > 1:
+        from tpu_rl.fleet import replica_main
+
+        for i in range(1, cfg.inference_replicas):
+            # Child names follow the chaos plane's prefix convention:
+            # ``kill:inference-1@t+8s`` targets exactly these processes.
+            sup.spawn(
+                f"inference-{i}",
+                functools.partial(replica_main, seed=seed),
+                cfg,
+                i,
+                inference_ports[i],
+                "127.0.0.1",  # learner (model PUB) is on this host
+                machines.model_port,
+                machines.learner_port,
+                cpu_only=(cfg.learner_device == "cpu"),
+            )
     return sup
 
 
@@ -449,9 +472,14 @@ def worker_role(
                 worker_main,
                 seed=seed * 1000 + machine_idx * 100 + i,
                 initial_params=initial_params,
+                # A fleet (N > 1) hands workers the full endpoint list so
+                # FleetClient can balance/hedge; a single service keeps the
+                # scalar port and the plain InferenceClient.
                 inference_port=(
-                    machines.inference_port
-                    if cfg.act_mode == "remote" else None
+                    None if cfg.act_mode != "remote"
+                    else machines.inference_ports(cfg)
+                    if cfg.inference_replicas > 1
+                    else machines.inference_port
                 ),
             ),
             cfg,

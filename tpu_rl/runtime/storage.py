@@ -115,6 +115,13 @@ class LearnerStorage:
         self.n_stale_epoch = 0
         # Worker join/leave registry (heartbeat lease over frame arrivals).
         self.members = MembershipTable(cfg.membership_lease_s)
+        # Inference-replica registry: same lease mechanics keyed by the
+        # `rid` on replica telemetry snapshots, plus per-replica served
+        # versions and the fleet's monotonic version floor. Import is lazy
+        # (fleet.membership subclasses MembershipTable from THIS module).
+        from tpu_rl.fleet.membership import ReplicaTable
+
+        self.replicas = ReplicaTable(cfg.membership_lease_s)
         self._next_evict = 0.0
         # Telemetry plane (tpu_rl.obs): the aggregator lives HERE — storage
         # is the learner-side edge of the stat channel, the one hop every
@@ -179,6 +186,7 @@ class LearnerStorage:
                 if now_m >= self._next_evict:
                     self._next_evict = now_m + 1.0
                     self.members.evict_expired(now_m)
+                    self.replicas.evict_expired(now_m)
                 if self.aggregator is not None:
                     self._telemetry_tick()
                 if self.heartbeat is not None:
@@ -319,6 +327,20 @@ class LearnerStorage:
         reg.counter("storage-members-evicted").set_total(
             self.members.n_evicted
         )
+        # Inference-fleet membership + the version-consistency watch: the
+        # floor is the ratchet clients pin to, min-active the worst
+        # staleness a balanced request can land on right now.
+        reg.gauge("fleet-replicas-active").set(len(self.replicas.active))
+        reg.counter("fleet-replicas-joined").set_total(
+            self.replicas.n_joined
+        )
+        reg.counter("fleet-replicas-evicted").set_total(
+            self.replicas.n_evicted
+        )
+        reg.gauge("fleet-version-floor").set(self.replicas.floor)
+        reg.gauge("fleet-min-active-version").set(
+            self.replicas.min_active_version()
+        )
         if self._chaos is not None:
             reg.counter("chaos-corrupted-frames").set_total(
                 self._chaos.n_corrupted
@@ -421,6 +443,7 @@ class LearnerStorage:
             # stale-epoch worker must stay visible to /healthz while it
             # re-attaches.
             self._touch_member(payload)
+            self._touch_replica(payload)
             if isinstance(payload, dict):
                 e = payload.get("epoch")
                 if isinstance(e, int) and e > self.run_epoch:
@@ -468,6 +491,25 @@ class LearnerStorage:
         if not isinstance(wid, int):
             return
         if self.members.touch(wid):
+            sa = self.stat_array
+            if sa is not None and len(sa) > SLOT_JOIN_REQ:
+                sa[SLOT_JOIN_REQ] = 1.0
+
+    def _touch_replica(self, payload) -> None:
+        """Renew an inference replica's lease from its telemetry snapshot
+        (``rid`` + served ``ver``). A NEW replica raises the same join flag
+        a worker join does: the learner's join-push re-broadcasts current
+        weights+ver, which is exactly what a random-init replica needs to
+        converge onto the live policy — zero new wire machinery."""
+        if not isinstance(payload, dict):
+            return
+        rid = payload.get("rid")
+        if not isinstance(rid, int):
+            return
+        ver = payload.get("ver")
+        if self.replicas.touch(
+            rid, ver=ver if isinstance(ver, int) else -1
+        ):
             sa = self.stat_array
             if sa is not None and len(sa) > SLOT_JOIN_REQ:
                 sa[SLOT_JOIN_REQ] = 1.0

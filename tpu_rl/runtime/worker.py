@@ -64,7 +64,7 @@ class Worker:
         heartbeat=None,
         initial_params=None,
         seed: int = 0,
-        inference_port: int | None = None,
+        inference_port: int | list[int] | None = None,
     ):
         self.cfg = cfg
         self.worker_id = worker_id
@@ -135,6 +135,26 @@ class Worker:
             file=sys.stderr,
             flush=True,
         )
+
+    def _make_remote(self, cfg: Config, learner_ip: str):
+        """Build the remote-acting client for ``self.inference_port``: a
+        fleet of endpoints (list of ports — hedged, load-balanced
+        :class:`~tpu_rl.fleet.client.FleetClient`) or the single-service
+        :class:`InferenceClient`. Used for both the initial client and
+        every re-probe, so a fallback under a fleet re-probes the WHOLE
+        fleet — one replica's death can only strand the worker on local
+        acting while every replica is unreachable."""
+        port = self.inference_port
+        if isinstance(port, (list, tuple)):
+            from tpu_rl.fleet import FleetClient
+
+            return FleetClient(
+                cfg, [(learner_ip, int(p)) for p in port],
+                wid=self.worker_id,
+            )
+        from tpu_rl.runtime.inference_service import InferenceClient
+
+        return InferenceClient(cfg, learner_ip, port, wid=self.worker_id)
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -222,11 +242,7 @@ class Worker:
         # ever becomes unreachable.
         remote = None
         if cfg.act_mode == "remote" and self.inference_port is not None:
-            from tpu_rl.runtime.inference_service import InferenceClient
-
-            remote = InferenceClient(
-                cfg, learner_ip, self.inference_port, wid=self.worker_id
-            )
+            remote = self._make_remote(cfg, learner_ip)
         # Corrupt-reply count accumulated from CLOSED inference clients
         # (each fallback/failed probe folds its client's n_rejected in
         # before closing); the live client's count is added at read sites,
@@ -234,6 +250,19 @@ class Worker:
         # cycles (satellite of ISSUE 3: remote-acting drops were invisible
         # — only the model-SUB count reached the dashboards).
         remote_rejected = 0
+        # Fleet-event totals accumulated the same way across client
+        # generations (FleetClient only; 0 forever under a single service).
+        fleet_hedges = fleet_failovers = 0
+        fleet_dedups = fleet_floor_rejects = 0
+
+        def _fold_fleet(client) -> None:
+            nonlocal fleet_hedges, fleet_failovers
+            nonlocal fleet_dedups, fleet_floor_rejects
+            fleet_hedges += getattr(client, "n_hedges", 0)
+            fleet_failovers += getattr(client, "n_failovers", 0)
+            fleet_dedups += getattr(client, "n_dedups", 0)
+            fleet_floor_rejects += getattr(client, "n_floor_rejects", 0)
+
         # Fallback recovery state: when remote acting drops to local, probe
         # the service again every `inference_reprobe_s`, doubling up to
         # `inference_reprobe_max_s` while it stays down. 0 disables (the
@@ -322,6 +351,7 @@ class Worker:
                     # RESTARTED server regains this client.
                     self._log_fallback(cfg, reprobe_backoff)
                     remote_rejected += remote.n_rejected
+                    _fold_fleet(remote)
                     remote.close()
                     remote = None
                     self.fell_back = True
@@ -334,19 +364,16 @@ class Worker:
                     and time.monotonic() >= next_reprobe
                 ):
                     # Re-probe: one zero-retry request on a FRESH client
-                    # (fresh DEALER identity — the old one may be black-
-                    # holed in a dead server's queue). Success restores
-                    # remote acting and this tick already has its reply;
-                    # failure costs one inference_timeout_ms and doubles
-                    # the probe interval.
-                    from tpu_rl.runtime.inference_service import (
-                        InferenceClient,
-                    )
-
-                    probe = InferenceClient(
-                        cfg, learner_ip, self.inference_port,
-                        wid=self.worker_id,
-                    )
+                    # (fresh DEALER identities — the old ones may be black-
+                    # holed in a dead server's queue). Under a fleet the
+                    # probe client spans every replica, so ANY healthy
+                    # replica restores remote acting — a single timeout
+                    # never strands the worker on local acting while the
+                    # rest of the fleet is up. Success restores remote
+                    # acting and this tick already has its reply; failure
+                    # costs one inference_timeout_ms and doubles the probe
+                    # interval.
+                    probe = self._make_remote(cfg, learner_ip)
                     self.n_reprobes += 1
                     reply = probe.act(obs, is_fir, retries=0)
                     if reply is not None:
@@ -358,6 +385,7 @@ class Worker:
                         self._log_restore()
                     else:
                         remote_rejected += probe.n_rejected
+                        _fold_fleet(probe)
                         probe.close()
                         reprobe_backoff = min(
                             reprobe_backoff * 2.0,
@@ -517,6 +545,22 @@ class Worker:
                         registry.counter(
                             "worker-remote-restores"
                         ).set_total(self.n_restores)
+                        registry.counter("fleet-hedge-fired").set_total(
+                            fleet_hedges
+                            + getattr(remote, "n_hedges", 0)
+                        )
+                        registry.counter("fleet-failovers").set_total(
+                            fleet_failovers
+                            + getattr(remote, "n_failovers", 0)
+                        )
+                        registry.counter("fleet-dedup-replies").set_total(
+                            fleet_dedups
+                            + getattr(remote, "n_dedups", 0)
+                        )
+                        registry.counter("fleet-floor-rejects").set_total(
+                            fleet_floor_rejects
+                            + getattr(remote, "n_floor_rejects", 0)
+                        )
                     if chaos is not None:
                         registry.counter(
                             "chaos-corrupted-frames"
@@ -570,7 +614,7 @@ def worker_main(
     heartbeat,
     initial_params=None,
     seed: int = 0,
-    inference_port: int | None = None,
+    inference_port: int | list[int] | None = None,
 ) -> None:
     """mp.Process target (reference ``worker_run``, ``main.py:155-162``)."""
     Worker(
