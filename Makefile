@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
-	resume-smoke slo-smoke loadgen-smoke ci
+	resume-smoke slo-smoke loadgen-smoke heal-smoke ci
 
 lint:
 	ruff check .
@@ -110,6 +110,14 @@ slo-smoke:
 loadgen-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/loadgen_smoke.py
 
+# Self-healing smoke: in-jit guard bit-identity + NaN containment, then a
+# NaN/spike data-chaos cluster run — >=1 watchdog rollback to a committed
+# checkpoint with an epoch fence, the poisoned worker quarantined and later
+# cleared, exact injected==poisoned accounting — then a clean run where the
+# armed healing plane changes nothing.
+heal-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/heal_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
-	loadgen-smoke
+	loadgen-smoke heal-smoke

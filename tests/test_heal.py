@@ -1,0 +1,414 @@
+"""Self-healing plane (tpu_rl.heal) tests: in-jit update guards (bit
+identity + NaN containment across every algo and the chained dispatch),
+the divergence watchdog on synthetic traces, the windowed rollback budget,
+ingress validation + the quarantine strike/clear lifecycle, the chaos data
+faults (``nan:``/``spike:`` grammar and injector), the nth-latest
+checkpoint reader behind rollback, and the `==` SLO comparator."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import small_config
+from tests.test_algos import make_batch
+from tpu_rl.algos.registry import get_algo
+from tpu_rl.heal import DivergenceWatchdog, IngressGuard, RollbackBudget
+
+ALL_ALGOS = [
+    "PPO", "PPO-Continuous", "IMPALA", "V-MPO", "SAC", "SAC-Continuous",
+]
+
+
+def _algo_cfg(algo, **kw):
+    return small_config(
+        algo=algo,
+        action_space=1 if "Continuous" in algo else 2,
+        is_continuous="Continuous" in algo,
+        **kw,
+    )
+
+
+def _assert_trees_identical(a, b, what=""):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), what
+    for x, y in zip(la, lb, strict=True):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=what)
+
+
+def _param_trees(state):
+    if hasattr(state, "params"):
+        return (state.params, state.opt_state)
+    return (
+        state.actor_params, state.critic_params, state.target_critic_params,
+        state.log_alpha, state.actor_opt, state.critic_opt, state.alpha_opt,
+    )
+
+
+# ------------------------------------------------------------- in-jit guards
+@pytest.mark.parametrize("algo", ALL_ALGOS)
+def test_guard_on_clean_is_bit_identical(algo):
+    """With finite data the guard's lax.cond true branch is literally the
+    pre-guard update: every state leaf must match guard-off bitwise."""
+    cfg_on = _algo_cfg(algo, update_guard=True)
+    cfg_off = _algo_cfg(algo, update_guard=False)
+    fam, s_on, step_on = get_algo(algo).build(cfg_on, jax.random.PRNGKey(0))
+    _, s_off, step_off = get_algo(algo).build(cfg_off, jax.random.PRNGKey(0))
+    batch = make_batch(cfg_on, fam)
+    k = jax.random.PRNGKey(1)
+    s_on1, m_on = jax.jit(step_on)(s_on, batch, k)
+    s_off1, m_off = jax.jit(step_off)(s_off, batch, k)
+    _assert_trees_identical(s_on1, s_off1, algo)
+    assert float(m_on["nonfinite-updates"]) == 0.0
+    assert "nonfinite-updates" not in m_off
+
+
+@pytest.mark.parametrize("algo", ALL_ALGOS)
+def test_guard_contains_nonfinite_update(algo):
+    """A NaN batch must leave every parameter, optimizer-state, and target
+    leaf bitwise untouched, and count one skip per sub-update."""
+    cfg = _algo_cfg(algo, update_guard=True)
+    fam, state, train_step = get_algo(algo).build(cfg, jax.random.PRNGKey(0))
+    batch = make_batch(cfg, fam)
+    bad = batch.replace(obs=batch.obs.at[0, 0].set(jnp.nan))
+    s1, m = jax.jit(train_step)(state, bad, jax.random.PRNGKey(1))
+    _assert_trees_identical(_param_trees(s1), _param_trees(state), algo)
+    assert float(m["nonfinite-updates"]) == float(cfg.K_epoch)
+    # step still advances: the dispatch happened, the update was skipped
+    assert int(s1.step) == int(state.step) + 1
+
+
+def test_guard_skip_count_rides_chained_dispatch():
+    """chain=K sums per-update skip counts over the scan axis (dp.py): one
+    poisoned slice out of K must report exactly K_epoch skips."""
+    from tpu_rl.parallel import (
+        make_parallel_train_step,
+        make_mesh,
+        replicate,
+        shard_chained_batch,
+    )
+
+    cfg = small_config(algo="PPO", batch_size=8, update_guard=True)
+    fam, state, train_step = get_algo("PPO").build(cfg, jax.random.PRNGKey(0))
+    clean = make_batch(cfg, fam, key=1)
+    poisoned = clean.replace(obs=clean.obs.at[0, 0].set(jnp.nan))
+    mesh = make_mesh(4)
+    cstep = make_parallel_train_step(train_step, mesh, cfg, chain=2)
+    _, metrics = cstep(
+        replicate(state, mesh),
+        shard_chained_batch([clean, poisoned], mesh),
+        replicate(jax.random.PRNGKey(2), mesh),
+    )
+    assert float(metrics["nonfinite-updates"]) == float(cfg.K_epoch)
+
+
+# ---------------------------------------------------------------- watchdog
+def test_watchdog_clean_trace_never_trips():
+    wd = DivergenceWatchdog(window=8, z_max=6.0, sustain=3)
+    for i in range(200):
+        assert not wd.observe({"loss": 1.0 + 0.05 * np.sin(i)})
+
+
+def test_watchdog_slow_drift_never_trips():
+    """A drifting-but-smooth signal tracks its own EWMA baseline."""
+    wd = DivergenceWatchdog(window=8, z_max=6.0, sustain=3)
+    for i in range(300):
+        assert not wd.observe({"loss": 1.0 + 0.01 * i})
+
+
+def test_watchdog_sustained_spike_trips_at_sustain():
+    wd = DivergenceWatchdog(window=8, z_max=6.0, sustain=3)
+    rng = np.random.default_rng(0)
+    for i in range(50):  # warm the stats past the window
+        wd.observe({"loss": 1.0 + 0.01 * rng.standard_normal()})
+    assert not wd.observe({"loss": 1e6})
+    assert not wd.observe({"loss": 1e6})
+    assert wd.observe({"loss": 1e6})
+    assert "loss" in wd.last_reason
+
+
+def test_watchdog_single_spike_is_noise_not_a_trip():
+    wd = DivergenceWatchdog(window=8, z_max=6.0, sustain=3)
+    for i in range(50):
+        wd.observe({"loss": 1.0})
+    assert not wd.observe({"loss": 1e6})
+    for i in range(20):  # streak resets on the next clean check
+        assert not wd.observe({"loss": 1.0})
+
+
+def test_watchdog_nonfinite_host_signal_trips_without_warmup():
+    """A non-finite observable is anomalous from sample one — no z-score
+    warmup applies (the stats never even see it)."""
+    wd = DivergenceWatchdog(window=32, z_max=6.0, sustain=2)
+    assert not wd.observe({"loss": float("nan")})
+    assert wd.observe({"loss": float("inf")})
+
+
+def test_watchdog_nonfinite_counter_channel():
+    wd = DivergenceWatchdog(nonfinite_max=3)
+    assert not wd.note_nonfinite(2.0)
+    assert wd.note_nonfinite(3.0)
+    assert "nonfinite" in wd.last_reason
+
+
+def test_watchdog_reset_restarts_detection():
+    wd = DivergenceWatchdog(window=8, z_max=6.0, sustain=1)
+    for i in range(50):
+        wd.observe({"loss": 1.0})
+    assert wd.observe({"loss": 1e6})
+    wd.reset()
+    # Fresh stats are warming up again: the same magnitude is not anomalous.
+    assert not wd.observe({"loss": 1e6})
+
+
+def test_rollback_budget_window_and_exhaustion():
+    t = [0.0]
+    budget = RollbackBudget(max_rollbacks=2, window_s=10.0, clock=lambda: t[0])
+    assert not budget.exhausted()
+    budget.record()
+    t[0] = 1.0
+    budget.record()
+    assert budget.used == 2
+    assert budget.exhausted()
+    t[0] = 12.0  # both rollbacks age out of the trailing window
+    assert not budget.exhausted()
+    assert budget.used == 0
+
+
+# --------------------------------------------- ingress guard + quarantine
+def _frame(obs=0.5, rew=0.1, wid=1):
+    return {
+        "obs": np.full((4, 3), obs, np.float32),
+        "rew": np.full((4, 1), rew, np.float32),
+        "wid": wid,
+    }
+
+
+def test_ingress_guard_classifies():
+    g = IngressGuard(abs_max=1e6)
+    assert g.tick_clean(_frame())
+    assert not g.tick_clean(_frame(obs=np.nan))
+    assert not g.tick_clean(_frame(rew=np.nan))
+    assert not g.tick_clean(_frame(obs=1e9))  # finite spike over the bound
+    assert not g.tick_clean(_frame(rew=-1e9))
+    assert g.tick_clean({})  # no validated columns -> clean
+    assert g.n_checked == 6
+
+
+def test_membership_quarantine_lifecycle():
+    from tpu_rl.runtime.storage import MembershipTable
+
+    t = [0.0]
+    mt = MembershipTable(lease_s=60.0, clock=lambda: t[0])
+    # Strikes below the limit never quarantine.
+    assert not mt.strike(1, limit=3)
+    assert not mt.strike(1, limit=3)
+    assert not mt.is_quarantined(1)
+    assert mt.strike(1, limit=3)  # third strike trips
+    assert mt.is_quarantined(1)
+    assert mt.n_quarantines == 1
+    # Another poisoned frame refreshes the cooldown clock, no double count.
+    t[0] = 1.0
+    assert not mt.strike(1, limit=3)
+    assert mt.n_quarantines == 1
+    # A clean frame before the cooldown does NOT clear.
+    t[0] = 2.5
+    assert not mt.probe_clear(1, cooldown=2.0)
+    assert mt.is_quarantined(1)
+    # After the cooldown the clean re-probe clears and resets strikes.
+    t[0] = 3.5
+    assert mt.probe_clear(1, cooldown=2.0)
+    assert not mt.is_quarantined(1)
+    assert mt.strikes[1] == 0
+    assert mt.n_unquarantines == 1
+    # Other wids are untouched throughout.
+    assert not mt.is_quarantined(2)
+
+
+def test_storage_ingress_admit_counts_and_parity():
+    """The single-site drop accounting: poisoned frames count poisoned even
+    from a quarantined wid (exact chaos parity), clean frames from a
+    quarantined wid count quarantined-frames until the cooldown clears."""
+    from tpu_rl.runtime.storage import LearnerStorage, MembershipTable
+
+    cfg = small_config(
+        ingress_validate=True, quarantine_strikes=2, quarantine_clear_s=5.0
+    )
+    store = LearnerStorage.__new__(LearnerStorage)  # no sockets/shm needed
+    store.cfg = cfg
+    t = [0.0]
+    store.members = MembershipTable(lease_s=60.0, clock=lambda: t[0])
+    store._ingress = IngressGuard(abs_max=cfg.ingress_abs_max)
+
+    assert store._ingress_admit(_frame())
+    assert not store._ingress_admit(_frame(obs=np.nan))  # strike 1
+    assert not store._ingress_admit(_frame(obs=np.nan))  # strike 2 -> jail
+    assert store.members.is_quarantined(1)
+    # Poisoned while quarantined: still poisoned (parity), never quarantined-
+    # frames; refreshes the cooldown.
+    t[0] = 1.0
+    assert not store._ingress_admit(_frame(obs=np.nan))
+    assert store._ingress.n_poisoned == 3
+    assert store._ingress.n_quarantined_frames == 0
+    # Clean while quarantined, inside cooldown: dropped + counted separately.
+    t[0] = 3.0
+    assert not store._ingress_admit(_frame())
+    assert store._ingress.n_quarantined_frames == 1
+    # Clean after cooldown: clears and admits.
+    t[0] = 7.0
+    assert store._ingress_admit(_frame())
+    assert not store.members.is_quarantined(1)
+    assert store._ingress.n_poisoned == 3
+
+
+# ------------------------------------------------------- chaos data faults
+def test_chaos_grammar_parses_data_clauses():
+    from tpu_rl.chaos import FaultPlan
+
+    plan = FaultPlan.parse(
+        "nan:rollout@p=0.5@t+2s@for=3s@wid=1,spike:rollout@p=0.25,"
+        "nan:logp@p=1.0@wid=0,kill:worker-0-1@t+6s"
+    )
+    f = plan.data_faults()[0]
+    assert (f.action, f.target, f.p) == ("nan", "rollout", 0.5)
+    assert (f.at_s, f.dur_s, f.wid, f.site) == (2.0, 3.0, 1, "worker")
+    assert len(plan.data_faults()) == 3
+    # wid filtering: wid=None faults apply to every instance
+    assert [x.action for x in plan.data_faults(1)] == ["nan", "spike"]
+    assert [x.target for x in plan.data_faults(0)] == ["rollout", "logp"]
+    # Data faults never leak into the transport shim lists.
+    send_f, recv_f = plan.transport_faults("worker")
+    assert send_f == [] and recv_f == []
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nan:rollout",  # missing p
+        "nan:model@p=0.5",  # not a data target
+        "spike:rollout@p=0.5@for=xs",  # unparseable window length
+        "nan:rollout@p=0.5@wid=one",  # unparseable wid
+    ],
+)
+def test_chaos_grammar_rejects_bad_data_clauses(bad):
+    from tpu_rl.chaos import FaultPlan
+
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_data_chaos_window_and_injection_parity():
+    from tpu_rl.chaos import DataChaos, FaultPlan
+
+    plan = FaultPlan.parse(
+        "nan:rollout@p=1.0@t+1s@for=2s,spike:rollout@p=1.0@t+1s@for=2s,"
+        "nan:logp@p=1.0@t+1s@for=2s"
+    )
+    t = [0.0]
+    dc = DataChaos(plan.data_faults(), seed=3, clock=lambda: t[0])
+
+    def payload():
+        return {
+            "obs": np.zeros((2, 3), np.float32),
+            "rew": np.zeros((2, 1), np.float32),
+            "log_prob": np.zeros((2, 1), np.float32),
+        }
+
+    p = payload()
+    t[0] = 0.5  # before the window: untouched
+    dc.on_tick(p)
+    assert np.isfinite(p["obs"]).all() and np.isfinite(p["log_prob"]).all()
+    assert dc.n_nan + dc.n_spike + dc.n_logp_nan == 0
+
+    t[0] = 1.5  # inside: both rollout faults fire, but only ONE lands
+    for _ in range(5):
+        dc.on_tick(payload())
+    assert dc.n_nan + dc.n_spike == 5  # exact injected==poisoned parity
+    assert dc.n_logp_nan == 5  # logp is a separate channel
+
+    before = (dc.n_nan, dc.n_spike, dc.n_logp_nan)
+    t[0] = 3.5  # past the window: silent again
+    p = payload()
+    dc.on_tick(p)
+    assert np.isfinite(p["obs"]).all()
+    assert (dc.n_nan, dc.n_spike, dc.n_logp_nan) == before
+
+
+def test_data_chaos_copies_read_only_columns():
+    """Worker payload columns are numpy views of jax outputs (read-only):
+    the injector must swap in a writable copy, never touch the original."""
+    from tpu_rl.chaos import DataChaos, FaultPlan
+
+    dc = DataChaos(
+        FaultPlan.parse("nan:logp@p=1.0").data_faults(), seed=0
+    )
+    orig = np.zeros((2, 1), np.float32)
+    orig.setflags(write=False)
+    p = {"log_prob": orig}
+    dc.on_tick(p)
+    assert np.isnan(p["log_prob"]).any()
+    assert p["log_prob"] is not orig
+    assert np.isfinite(orig).all()
+
+
+def test_maybe_data_chaos_respects_wid():
+    from tpu_rl.chaos import maybe_data_chaos
+
+    cfg = small_config(chaos_spec="nan:rollout@p=0.5@wid=1", chaos_seed=9)
+    assert maybe_data_chaos(cfg, "worker", instance=0) is None
+    assert maybe_data_chaos(cfg, "worker", instance=1) is not None
+    assert maybe_data_chaos(small_config(), "worker", instance=1) is None
+
+
+# ------------------------------------------------- rollback checkpoint reader
+def test_restore_nth_latest_and_discard_above(tmp_path):
+    from tpu_rl.checkpoint import Checkpointer
+
+    def _state(val):
+        return {"w": np.full((3,), val, np.float32)}
+
+    ck = Checkpointer(str(tmp_path), "PPO")
+    assert ck.restore_nth_latest(_state(0.0)) is None  # nothing committed
+    for idx, val in ((100, 1.0), (200, 2.0), (300, 3.0)):
+        ck.save(_state(val), idx)
+
+    got, idx, _meta = ck.restore_nth_latest(_state(0.0), n=1)
+    assert idx == 300 and float(got["w"][0]) == 3.0
+    got, idx, _meta = ck.restore_nth_latest(_state(0.0), n=2)
+    assert idx == 200 and float(got["w"][0]) == 2.0
+    got, idx, _meta = ck.restore_nth_latest(_state(0.0), n=99)  # clamps
+    assert idx == 100 and float(got["w"][0]) == 1.0
+
+    assert ck.discard_above(200) == 1  # the diverged newest is gone
+    assert ck.latest_idx() == 200
+    got, idx, _meta = ck.restore_nth_latest(_state(0.0), n=1)
+    assert idx == 200
+    ck.close()
+
+
+# -------------------------------------------------------- config + slo glue
+def test_config_watchdog_requires_guard_and_ckpt_depth():
+    with pytest.raises(AssertionError):
+        small_config(watchdog_enabled=True, update_guard=False)
+    with pytest.raises(AssertionError):
+        small_config(watchdog_enabled=True, ckpt_keep=1)
+    cfg = small_config(watchdog_enabled=True, ckpt_keep=2)
+    assert cfg.update_guard
+    with pytest.raises(AssertionError):
+        small_config(watchdog_window=1)
+    with pytest.raises(AssertionError):
+        small_config(quarantine_strikes=0)
+
+
+def test_slo_equality_comparator():
+    from tpu_rl.obs.slo import parse_slo_spec
+
+    rule = parse_slo_spec("counter:learner-nonfinite-updates==0")[0]
+    assert rule.op == "==" and rule.threshold == 0.0
+    assert rule.upper_bound  # worst-cased by the largest source value
+    assert rule.check(0.0)
+    assert not rule.check(1.0)
+    # The longest-first op scan still resolves <= and >= correctly.
+    assert parse_slo_spec("gauge:x<=3")[0].op == "<="
+    assert parse_slo_spec("gauge:x>=3")[0].op == ">="

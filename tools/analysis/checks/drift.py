@@ -57,6 +57,7 @@ CONFIG_VALIDATE_EXEMPT: dict[str, str] = {
     "coef_alpha_upper": "V-MPO dual lr; any positive-ish float, consumed by optax",
     "coef_alpha_below": "V-MPO dual lr; any positive-ish float, consumed by optax",
     "chaos_seed": "any int seeds the per-site RNG streams",
+    "ingress_validate": "boolean plane switch; both values valid",
     "slo_fail_run": "boolean exit gate; both values valid",
     "obs_shape": "runtime-derived by probe_spaces, never user-set",
     "action_space": "runtime-derived by probe_spaces, never user-set",

@@ -51,6 +51,16 @@ MANIFEST: dict[str, dict[str, str]] = {
         "LearnerStorage._epoch_admit": STRICT,
         "LearnerStorage._touch_member": STRICT,
         "LearnerStorage._poll_epoch": STRICT,
+        "LearnerStorage._ingress_admit": STRICT,
+        "MembershipTable.strike": STRICT,
+        "MembershipTable.is_quarantined": STRICT,
+        "MembershipTable.probe_clear": STRICT,
+    },
+    "tpu_rl/heal/ingress.py": {
+        "IngressGuard.tick_clean": STRICT,
+    },
+    "tpu_rl/chaos/inject.py": {
+        "DataChaos.on_tick": STRICT,
     },
     "tpu_rl/data/assembler.py": {
         "RolloutAssembler.push_tick": STRICT,

@@ -15,6 +15,7 @@ import optax
 
 from tpu_rl.algos.base import TrainState, rmsprop
 from tpu_rl.config import Config
+from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
 from tpu_rl.ops import distributions as D
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
@@ -83,17 +84,36 @@ def make_train_step(cfg: Config, family: ModelFamily):
         }
         return loss, metrics
 
+    guard = cfg.update_guard
+
     def train_step(state: TrainState, batch: Batch, key: jax.Array):
         metrics = {}
+        nf = 0.0
         for _ in range(cfg.K_epoch):
             (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, batch
             )
             grads, gnorm = clip_subtree_by_global_norm(grads, cfg.max_grad_norm)
-            updates, opt_state = opt.update(grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
+            if guard:
+                ok = update_ok(metrics["loss"], gnorm)
+
+                def _apply(grads=grads, state=state):
+                    updates, opt_state = opt.update(
+                        grads, state.opt_state, state.params
+                    )
+                    return optax.apply_updates(state.params, updates), opt_state
+
+                params, opt_state = guarded(
+                    ok, _apply, (state.params, state.opt_state)
+                )
+                nf = nf + (1.0 - ok.astype(jnp.float32))
+            else:
+                updates, opt_state = opt.update(grads, state.opt_state, state.params)
+                params = optax.apply_updates(state.params, updates)
             state = state.replace(params=params, opt_state=opt_state)
             metrics["grad-norm"] = gnorm
+        if guard:
+            metrics["nonfinite-updates"] = nf
         return state.replace(step=state.step + 1), metrics
 
     return train_step

@@ -20,6 +20,7 @@ import optax
 
 from tpu_rl.algos.base import SACState, adam
 from tpu_rl.config import Config
+from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
 from tpu_rl.ops.distributions import tanh_normal_sample
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
@@ -55,6 +56,8 @@ def make_train_step(cfg: Config, family: ModelFamily):
         target_entropy = -float(cfg.action_space)
     else:
         target_entropy = 0.98 * float(jnp.log(cfg.action_space))
+
+    guard = cfg.update_guard
 
     def _critic_apply(cp, batch: Batch, act, carry0):
         if continuous:
@@ -95,9 +98,24 @@ def make_train_step(cfg: Config, family: ModelFamily):
         (loss_policy, ent_neg), g_actor = jax.value_and_grad(
             actor_loss, has_aux=True
         )(state.actor_params)
-        g_actor, _ = clip_subtree_by_global_norm(g_actor, cfg.max_grad_norm)
-        up, actor_opt = opt_actor.update(g_actor, state.actor_opt, state.actor_params)
-        actor_params = optax.apply_updates(state.actor_params, up)
+        g_actor, gn_actor = clip_subtree_by_global_norm(g_actor, cfg.max_grad_norm)
+        if guard:
+            ok_a = update_ok(loss_policy, gn_actor)
+
+            def _apply_actor():
+                up, actor_opt = opt_actor.update(
+                    g_actor, state.actor_opt, state.actor_params
+                )
+                return optax.apply_updates(state.actor_params, up), actor_opt
+
+            actor_params, actor_opt = guarded(
+                ok_a, _apply_actor, (state.actor_params, state.actor_opt)
+            )
+        else:
+            up, actor_opt = opt_actor.update(
+                g_actor, state.actor_opt, state.actor_params
+            )
+            actor_params = optax.apply_updates(state.actor_params, up)
 
         # ---- 2) temperature update (sac/learning.py:64-74). Documented
         # divergence: the reference computes +alpha*(logpi + target), whose
@@ -114,13 +132,31 @@ def make_train_step(cfg: Config, family: ModelFamily):
             )
 
         loss_alpha, g_alpha = jax.value_and_grad(alpha_loss_fn)(state.log_alpha)
-        up, alpha_opt = opt_alpha.update(g_alpha, state.alpha_opt, state.log_alpha)
-        log_alpha = optax.apply_updates(state.log_alpha, up)
-        if cfg.alpha_min > 0.0:
-            # Exploration floor (Config.alpha_min): clamp post-update so the
-            # controller can still raise alpha freely but cannot extinguish
-            # exploration on sparse-goal envs.
-            log_alpha = jnp.maximum(log_alpha, jnp.log(cfg.alpha_min))
+        if guard:
+            ok_al = jnp.isfinite(loss_alpha)
+
+            def _apply_alpha():
+                up, alpha_opt = opt_alpha.update(
+                    g_alpha, state.alpha_opt, state.log_alpha
+                )
+                la = optax.apply_updates(state.log_alpha, up)
+                if cfg.alpha_min > 0.0:
+                    la = jnp.maximum(la, jnp.log(cfg.alpha_min))
+                return la, alpha_opt
+
+            log_alpha, alpha_opt = guarded(
+                ok_al, _apply_alpha, (state.log_alpha, state.alpha_opt)
+            )
+        else:
+            up, alpha_opt = opt_alpha.update(
+                g_alpha, state.alpha_opt, state.log_alpha
+            )
+            log_alpha = optax.apply_updates(state.log_alpha, up)
+            if cfg.alpha_min > 0.0:
+                # Exploration floor (Config.alpha_min): clamp post-update so the
+                # controller can still raise alpha freely but cannot extinguish
+                # exploration on sparse-goal envs.
+                log_alpha = jnp.maximum(log_alpha, jnp.log(cfg.alpha_min))
 
         # ---- 3) critic update with updated actor + alpha (sac/learning.py:76-120)
         alpha2 = sg(jnp.exp(log_alpha))
@@ -160,16 +196,37 @@ def make_train_step(cfg: Config, family: ModelFamily):
             )
 
         loss_value, g_critic = jax.value_and_grad(critic_loss)(state.critic_params)
-        g_critic, _ = clip_subtree_by_global_norm(g_critic, cfg.max_grad_norm)
-        up, critic_opt = opt_critic.update(
-            g_critic, state.critic_opt, state.critic_params
-        )
-        critic_params = optax.apply_updates(state.critic_params, up)
+        g_critic, gn_critic = clip_subtree_by_global_norm(g_critic, cfg.max_grad_norm)
+        if guard:
+            ok_c = update_ok(loss_value, gn_critic)
 
-        # ---- 4) Polyak target update (a real one — see module docstring)
-        target_critic_params = polyak_update(
-            critic_params, state.target_critic_params, cfg.tau
-        )
+            def _apply_critic():
+                up, critic_opt = opt_critic.update(
+                    g_critic, state.critic_opt, state.critic_params
+                )
+                cp = optax.apply_updates(state.critic_params, up)
+                # Polyak tracks only APPLIED critic steps: a skipped update
+                # must leave the target frozen too, or the twin targets
+                # drift toward a never-taken critic.
+                return cp, critic_opt, polyak_update(
+                    cp, state.target_critic_params, cfg.tau
+                )
+
+            critic_params, critic_opt, target_critic_params = guarded(
+                ok_c,
+                _apply_critic,
+                (state.critic_params, state.critic_opt, state.target_critic_params),
+            )
+        else:
+            up, critic_opt = opt_critic.update(
+                g_critic, state.critic_opt, state.critic_params
+            )
+            critic_params = optax.apply_updates(state.critic_params, up)
+
+            # ---- 4) Polyak target update (a real one — see module docstring)
+            target_critic_params = polyak_update(
+                critic_params, state.target_critic_params, cfg.tau
+            )
 
         metrics = {
             "loss": cfg.policy_loss_coef * loss_policy
@@ -179,6 +236,11 @@ def make_train_step(cfg: Config, family: ModelFamily):
             "loss_alpha": loss_alpha,
             "alpha": jnp.exp(log_alpha),
         }
+        if guard:
+            metrics["grad-norm"] = gn_actor + gn_critic
+            metrics["nonfinite-updates"] = 1.0 - (
+                ok_a & ok_al & ok_c
+            ).astype(jnp.float32)
         return (
             state.replace(
                 actor_params=actor_params,
@@ -194,8 +256,13 @@ def make_train_step(cfg: Config, family: ModelFamily):
 
     def train_step(state: SACState, batch: Batch, key: jax.Array):
         metrics = {}
+        nf = 0.0
         for e in range(cfg.K_epoch):
             state, metrics = one_epoch(state, batch, jax.random.fold_in(key, e))
+            if guard:
+                nf = nf + metrics.pop("nonfinite-updates")
+        if guard:
+            metrics["nonfinite-updates"] = nf
         return state.replace(step=state.step + 1), metrics
 
     return train_step

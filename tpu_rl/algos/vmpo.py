@@ -31,6 +31,7 @@ import optax
 from tpu_rl.algos.base import TrainState, rmsprop
 from tpu_rl.algos.ppo import policy_outputs, td_target_and_gae
 from tpu_rl.config import Config
+from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
 from tpu_rl.ops.distributions import categorical_kl
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
@@ -127,8 +128,11 @@ def make_train_step(cfg: Config, family: ModelFamily):
         }
         return loss, metrics
 
+    guard = cfg.update_guard
+
     def train_step(state: TrainState, batch: Batch, key: jax.Array):
         metrics = {}
+        nf = 0.0
         for e in range(cfg.K_epoch):
             ekey = jax.random.fold_in(key, e)
             (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -137,17 +141,39 @@ def make_train_step(cfg: Config, family: ModelFamily):
             grads, gnorm = clip_subtree_by_global_norm(
                 grads, cfg.max_grad_norm, subtree="actor"
             )
-            updates, opt_state = opt.update(grads, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
-            # Projected floor on the temperature: eta -> 0 makes the psi
-            # weights one-hot and the advantage ratios arbitrarily large.
-            # Projection after the step (not clipping inside the loss, which
-            # would zero the dual's gradient and freeze it below the floor).
-            params["log_eta"] = jnp.maximum(
-                params["log_eta"], jnp.log(1e-6)
-            )
+            if guard:
+                ok = update_ok(metrics["loss"], gnorm)
+
+                def _apply(grads=grads, state=state):
+                    updates, opt_state = opt.update(
+                        grads, state.opt_state, state.params
+                    )
+                    params = optax.apply_updates(state.params, updates)
+                    # The eta floor projection belongs to the apply branch:
+                    # a skipped update must leave params bitwise untouched.
+                    params["log_eta"] = jnp.maximum(
+                        params["log_eta"], jnp.log(1e-6)
+                    )
+                    return params, opt_state
+
+                params, opt_state = guarded(
+                    ok, _apply, (state.params, state.opt_state)
+                )
+                nf = nf + (1.0 - ok.astype(jnp.float32))
+            else:
+                updates, opt_state = opt.update(grads, state.opt_state, state.params)
+                params = optax.apply_updates(state.params, updates)
+                # Projected floor on the temperature: eta -> 0 makes the psi
+                # weights one-hot and the advantage ratios arbitrarily large.
+                # Projection after the step (not clipping inside the loss, which
+                # would zero the dual's gradient and freeze it below the floor).
+                params["log_eta"] = jnp.maximum(
+                    params["log_eta"], jnp.log(1e-6)
+                )
             state = state.replace(params=params, opt_state=opt_state)
             metrics["grad-norm"] = gnorm
+        if guard:
+            metrics["nonfinite-updates"] = nf
         return state.replace(step=state.step + 1), metrics
 
     return train_step

@@ -5,8 +5,10 @@ entirely off-path unless ``Config.chaos_spec`` is set.
 """
 
 from tpu_rl.chaos.inject import (
+    DataChaos,
     ServiceChaos,
     TransportChaos,
+    maybe_data_chaos,
     maybe_service_chaos,
     maybe_transport_chaos,
     site_seed,
@@ -15,11 +17,13 @@ from tpu_rl.chaos.plan import Fault, FaultPlan
 from tpu_rl.chaos.process import ProcessChaos
 
 __all__ = [
+    "DataChaos",
     "Fault",
     "FaultPlan",
     "ProcessChaos",
     "ServiceChaos",
     "TransportChaos",
+    "maybe_data_chaos",
     "maybe_service_chaos",
     "maybe_transport_chaos",
     "site_seed",

@@ -111,6 +111,96 @@ class TransportChaos:
         return out
 
 
+class DataChaos:
+    """Payload-VALUE faults (``nan``/``spike``) at the producing worker.
+
+    ``on_tick`` mutates the RolloutBatch dict just before the send —
+    read-only columns (numpy views of jax outputs) are swapped for
+    writable copies in the payload, so the worker's own actor state is
+    never touched. The frame stays wire-valid (CRC passes):
+    the corruption must be caught by the self-healing plane, not the
+    codec. Channels:
+
+    - ``rollout`` poisons obs+rew (``nan``) or writes a finite absurd
+      magnitude into obs (``spike``) — the columns ingress validates. At
+      most ONE rollout-channel injection lands per frame, so
+      ``n_nan + n_spike == storage-poisoned-frames`` holds exactly.
+    - ``logp`` poisons log_prob, which ingress deliberately does not
+      check: it reaches training and must be contained by the in-jit
+      guards + watchdog (defense in depth).
+
+    Active window per fault: ``t+..s`` offsets from construction (worker
+    start), ``for=..s`` bounds the length; absent = always / forever.
+    """
+
+    __slots__ = ("_faults", "_rng", "_clock", "_t0", "n_nan", "n_spike",
+                 "n_logp_nan")
+
+    SPIKE = 1e9  # finite, but past any sane Config.ingress_abs_max
+
+    def __init__(self, faults: list[Fault], seed: int, clock=time.monotonic):
+        self._faults = list(faults)
+        self._rng = np.random.default_rng(seed)
+        self._clock = clock
+        self._t0 = clock()
+        self.n_nan = 0
+        self.n_spike = 0
+        self.n_logp_nan = 0
+
+    def _active(self, f: Fault, now: float) -> bool:
+        if f.at_s is None:
+            return True
+        start = self._t0 + f.at_s
+        if now < start:
+            return False
+        if f.dur_s is not None and now > start + f.dur_s:
+            return False
+        return True
+
+    @staticmethod
+    def _writable(payload, key):
+        # jax outputs arrive as read-only numpy views; swap in a copy so
+        # the poke never touches the worker's own actor-side arrays.
+        x = payload.get(key)
+        if x is None:
+            return None
+        if not x.flags.writeable:
+            x = np.array(x)
+            payload[key] = x
+        return x
+
+    def on_tick(self, payload: dict) -> None:
+        """Maybe poison one RolloutBatch payload in place, pre-send."""
+        now = self._clock()
+        rollout_hit = False
+        for f in self._faults:
+            if not self._active(f, now):
+                continue
+            if self._rng.random() >= f.p:
+                continue
+            if f.target == "rollout":
+                if rollout_hit:
+                    continue  # one rollout injection per frame: exact parity
+                rollout_hit = True
+                obs = self._writable(payload, "obs")
+                if f.action == "nan":
+                    if obs is not None:
+                        obs.flat[0] = np.nan
+                    rew = self._writable(payload, "rew")
+                    if rew is not None:
+                        rew.flat[0] = np.nan
+                    self.n_nan += 1
+                else:  # spike: finite but absurd — trips the range check
+                    if obs is not None:
+                        obs.flat[0] = self.SPIKE
+                    self.n_spike += 1
+            else:  # logp
+                lp = self._writable(payload, "log_prob")
+                if lp is not None:
+                    lp.flat[0] = np.nan
+                    self.n_logp_nan += 1
+
+
 class ServiceChaos:
     """Inference-service faults: pre-flush stalls and swallowed replies."""
 
@@ -150,6 +240,21 @@ def maybe_transport_chaos(cfg, site: str, instance: int = 0):
         return None
     return TransportChaos(
         send_f, recv_f, seed=site_seed(getattr(cfg, "chaos_seed", 0), site, instance)
+    )
+
+
+def maybe_data_chaos(cfg, site: str = "worker", instance: int = 0):
+    """Build a ``DataChaos`` for one worker instance, or None. Faults
+    carrying ``wid=`` only reach the named instance; the rest of the fleet
+    gets None and keeps producing clean data."""
+    spec = getattr(cfg, "chaos_spec", None)
+    if not spec:
+        return None
+    faults = FaultPlan.parse(spec).data_faults(instance)
+    if not faults:
+        return None
+    return DataChaos(
+        faults, seed=site_seed(getattr(cfg, "chaos_seed", 0), site, instance)
     )
 
 

@@ -12,9 +12,12 @@ Grammar (comma-separated clauses)::
     spec      := clause ("," clause)*
     clause    := action ":" target ("@" qualifier)*
     action    := kill | stop | hang | corrupt | drop | delay | stall | refuse
-    qualifier := "t+<seconds>s"     (one-shot fire time, from fleet launch)
+               | nan | spike
+    qualifier := "t+<seconds>s"     (window start / one-shot fire time)
                | "p=<probability>"  (per-frame / per-event probability)
                | "<millis>ms"       (injected latency)
+               | "for=<seconds>s"   (data faults: window length; none = forever)
+               | "wid=<int>"        (data faults: only this worker instance)
 
 Actions by layer:
 
@@ -34,6 +37,18 @@ Actions by layer:
 - **service** (inference service): ``stall`` sleeps before a batch flush;
   ``refuse`` swallows a reply — the client sees a timeout, exercising the
   worker's fallback + re-probe path.
+- **data** (payload values, at the PRODUCING worker, pre-send): ``nan``
+  and ``spike`` corrupt rollout payload VALUES — not wire bytes, so the
+  frame decodes fine and must be caught by the self-healing plane
+  (ingress validation / in-jit guards), not by the codec. Targets:
+  ``rollout`` poisons obs+rew (the columns ingress validates — contained
+  at the storage edge), ``logp`` poisons log_prob (deliberately NOT
+  validated at ingress: it rides into training and must be contained by
+  the in-jit guards + watchdog — defense in depth). ``spike`` writes a
+  finite but absurd magnitude (1e9, over the default
+  ``Config.ingress_abs_max``). Optional ``t+..s``/``for=..s`` bound the
+  active window; ``wid=<n>`` restricts injection to one worker instance
+  so the rest of the fleet keeps learning.
 
 Pure stdlib so ``Config.validate()`` can parse-check specs cheaply.
 """
@@ -43,9 +58,15 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 ACTIONS = frozenset(
-    {"kill", "stop", "hang", "corrupt", "drop", "delay", "stall", "refuse"}
+    {
+        "kill", "stop", "hang", "corrupt", "drop", "delay", "stall",
+        "refuse", "nan", "spike",
+    }
 )
 PROCESS_ACTIONS = frozenset({"kill", "stop", "hang"})
+DATA_ACTIONS = frozenset({"nan", "spike"})
+# Data-fault targets: which payload columns get poisoned at the worker.
+DATA_TARGETS = frozenset({"rollout", "logp"})
 
 # Channel name -> (site, proto bytes consumed there). The proto values match
 # tpu_rl.runtime.protocol.Protocol but are spelled as ints so this module
@@ -83,6 +104,10 @@ class Fault:
     protos: frozenset[int] | None = None
     direction: str | None = None  # "send" | "recv"
     site: str | None = None  # role owning the shimmed socket
+    # Data faults only: active-window length after at_s (None = forever)
+    # and the single worker instance injected (None = every worker).
+    dur_s: float | None = None
+    wid: int | None = None
 
 
 def _parse_qualifier(clause: str, qual: str) -> dict:
@@ -102,6 +127,18 @@ def _parse_qualifier(clause: str, qual: str) -> dict:
             f"chaos clause {clause!r}: probability must be in (0, 1], "
             f"got {qual!r}"
         )
+    elif qual.startswith("for=") and qual.endswith("s"):
+        try:
+            dur = float(qual[4:-1])
+        except ValueError:
+            dur = -1.0
+        if dur > 0.0:
+            return {"dur_s": dur}
+    elif qual.startswith("wid="):
+        try:
+            return {"wid": int(qual[4:])}
+        except ValueError:
+            pass
     elif qual.endswith("ms"):
         try:
             ms = float(qual[:-2])
@@ -111,7 +148,8 @@ def _parse_qualifier(clause: str, qual: str) -> dict:
             return {"delay_ms": ms}
     raise ValueError(
         f"chaos clause {clause!r}: unknown qualifier {qual!r} "
-        "(expected 't+<sec>s', 'p=<prob>', or '<ms>ms')"
+        "(expected 't+<sec>s', 'p=<prob>', 'for=<sec>s', 'wid=<int>', "
+        "or '<ms>ms')"
     )
 
 
@@ -142,6 +180,20 @@ def _parse_clause(clause: str) -> Fault:
                 "fire time"
             )
         return Fault(action, target, at_s=quals["at_s"])
+    if action in DATA_ACTIONS:
+        if target not in DATA_TARGETS:
+            raise ValueError(
+                f"chaos clause {clause!r}: {action} targets payload data "
+                f"(one of {sorted(DATA_TARGETS)}), got {target!r}"
+            )
+        if quals.get("p") is None:
+            raise ValueError(
+                f"chaos clause {clause!r}: {action} needs 'p=<prob>'"
+            )
+        return Fault(
+            action, target, p=quals["p"], at_s=quals.get("at_s"),
+            dur_s=quals.get("dur_s"), wid=quals.get("wid"), site="worker",
+        )
     if action in ("corrupt", "drop"):
         if target not in CHANNELS:
             raise ValueError(
@@ -223,4 +275,14 @@ class FaultPlan:
             f
             for f in self.faults
             if f.action in ("stall", "refuse") and f.target == service
+        ]
+
+    def data_faults(self, instance: int | None = None) -> list[Fault]:
+        """nan/spike clauses, optionally filtered to one worker instance
+        (a fault with ``wid=None`` applies to every worker)."""
+        return [
+            f
+            for f in self.faults
+            if f.action in DATA_ACTIONS
+            and (instance is None or f.wid is None or f.wid == instance)
         ]

@@ -386,6 +386,55 @@ class Checkpointer:
         )
         return restored, idx, meta
 
+    def restore_nth_latest(
+        self,
+        template: Any,
+        n: int = 1,
+        fingerprint: str | None = None,
+        force: bool = False,
+    ) -> tuple[Any, int, dict] | None:
+        """Restore the ``n``-th newest committed checkpoint (``n=1`` is the
+        newest — equivalent to :meth:`restore_run`; ``n=2`` the previous).
+        The watchdog rollback path uses ``n=2``: the newest commit may
+        already contain the divergence it is rolling back from. ``n`` past
+        the oldest clamps to the oldest committed checkpoint. Same
+        fingerprint-refusal contract as :meth:`restore_run`."""
+        found = _ckpt_dirs(self.model_dir, self.algo)
+        if not found:
+            return None
+        idx, path = found[max(0, len(found) - max(1, int(n)))]
+        meta = read_meta(path)
+        stored = meta.get("fingerprint")
+        if fingerprint is not None and stored is not None and stored != fingerprint:
+            if not force:
+                raise RuntimeError(
+                    f"checkpoint {path} was written by a different config "
+                    f"(fingerprint {stored} != {fingerprint}); pass "
+                    "--resume-force to override"
+                )
+            print(
+                f"[checkpoint] WARNING: fingerprint mismatch ({stored} != "
+                f"{fingerprint}) overridden by resume_force",
+                flush=True,
+            )
+        restored = self._ckpt.restore(
+            path, jax.tree_util.tree_map(lambda x: x, template)
+        )
+        return restored, idx, meta
+
+    def discard_above(self, idx: int) -> int:
+        """Remove every COMMITTED checkpoint with index > ``idx``; returns
+        how many were removed. The rollback path calls this (after
+        :meth:`flush`, so no in-flight save can commit a newer dir behind
+        our back) — without it the next newest-wins resume would faithfully
+        reload the divergence that was just rolled back."""
+        removed = 0
+        for ck_idx, path in _ckpt_dirs(self.model_dir, self.algo):
+            if ck_idx > idx:
+                shutil.rmtree(path, ignore_errors=True)
+                removed += 1
+        return removed
+
     # -------------------------------------------------------------------- gc
     def _gc(self) -> None:
         """Bound disk usage (the reference keeps every checkpoint forever).

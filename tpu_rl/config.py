@@ -390,6 +390,53 @@ class Config:
     # joining raises the mailbox join flag so the learner pushes current
     # weights+ver immediately instead of waiting out rebroadcast_idle_s.
     membership_lease_s: float = 15.0
+    # ---- self-healing plane (tpu_rl.heal) ----
+    # In-jit non-finite update guards: every algo's train_step wraps its
+    # optimizer apply in a lax.cond over isfinite(loss) & isfinite(grad
+    # global-norm) — a bad update leaves params/opt state untouched and
+    # counts into the per-step "nonfinite-updates" metric. Guard off =
+    # literally the unguarded code (bit-identity pinned in tests).
+    update_guard: bool = True
+    # Host-side divergence watchdog at the learner: EWMA/z-score over loss,
+    # grad-norm and fleet mean return at the loss-log cadence, plus a
+    # cumulative non-finite-update channel. A sustained anomaly rolls the
+    # learner back to the PREVIOUS committed checkpoint, bumps the run
+    # epoch (fencing in-flight pre-rollback rollouts exactly like
+    # post-crash frames) and rebroadcasts weights. Off = no detector, no
+    # per-update accumulator.
+    watchdog_enabled: bool = False
+    # EWMA window (samples) for the per-signal mean/variance estimates;
+    # also the per-signal warmup before z-scores are trusted.
+    watchdog_window: int = 32
+    # |z| above this marks one check anomalous.
+    watchdog_z: float = 6.0
+    # Consecutive anomalous checks before a rollback triggers.
+    watchdog_sustain: int = 3
+    # Cumulative guard-skipped updates (since the last rollback) that
+    # trigger a rollback immediately — the contained-NaN-stream channel.
+    watchdog_nonfinite: int = 3
+    # Sliding-window rollback budget (the supervisor restart-budget shape):
+    # at most `max_rollbacks` rollbacks per trailing `rollback_window_s`
+    # seconds; an exhausted budget exits the learner cleanly — a run that
+    # keeps diverging is a bug to surface, not to hide in a restore loop.
+    max_rollbacks: int = 3
+    rollback_window_s: float = 600.0
+    # Ingress validation at the storage edge: vectorized finite/range
+    # checks over each RolloutBatch's obs/rew columns before epoch
+    # admission. Poisoned frames are dropped + counted
+    # (storage-poisoned-frames) and strike their wid's quarantine counter.
+    # Off = one `is None` check on the ingest path.
+    ingress_validate: bool = False
+    # Absolute-value bound for the ingress range check (observations and
+    # rewards beyond it are treated as poisoned even when finite).
+    ingress_abs_max: float = 1e6
+    # Poisoned frames from one wid before it is quarantined (frames
+    # dropped under storage-quarantined-frames, lease flagged).
+    quarantine_strikes: int = 3
+    # Quarantine cooldown: after this many seconds without a new poisoned
+    # frame, the wid's next CLEAN frame clears the quarantine and resets
+    # its strikes (un-quarantine on clean re-probe).
+    quarantine_clear_s: float = 2.0
     # ---- telemetry plane (tpu_rl.obs) ----
     # HTTP port for the storage-side exporter serving Prometheus text at
     # /metrics and staleness-aware liveness at /healthz. 0 = no server, no
@@ -574,6 +621,29 @@ class Config:
             "remove the newest committed checkpoint"
         )
         assert self.membership_lease_s > 0, self.membership_lease_s
+        assert self.watchdog_window >= 2, self.watchdog_window
+        assert self.watchdog_z > 0, self.watchdog_z
+        assert self.watchdog_sustain >= 1, self.watchdog_sustain
+        assert self.watchdog_nonfinite >= 1, self.watchdog_nonfinite
+        assert self.max_rollbacks >= 1, self.max_rollbacks
+        assert self.rollback_window_s > 0, self.rollback_window_s
+        assert self.ingress_abs_max > 0, self.ingress_abs_max
+        assert self.quarantine_strikes >= 1, self.quarantine_strikes
+        assert self.quarantine_clear_s >= 0, self.quarantine_clear_s
+        if self.watchdog_enabled:
+            # The rollback path restores the PREVIOUS committed checkpoint
+            # (the newest may already hold the divergence), so GC must keep
+            # at least two; and the nonfinite trigger channel reads the
+            # guard counter, so the guards must be on.
+            assert self.update_guard, (
+                "watchdog_enabled requires update_guard: the nonfinite "
+                "trigger channel reads the in-jit guard counter"
+            )
+            assert self.ckpt_keep >= 2, (
+                f"watchdog_enabled requires ckpt_keep >= 2 (got "
+                f"{self.ckpt_keep}): rollback restores the previous "
+                "committed checkpoint"
+            )
         if self.chaos_spec:
             # Parse-check here so a bad plan fails at config load, not
             # minutes later inside a spawned child. plan.py is stdlib-only,

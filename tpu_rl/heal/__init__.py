@@ -1,0 +1,26 @@
+"""Self-healing training plane (PR 13).
+
+Three independent layers, each usable alone:
+
+- :mod:`tpu_rl.heal.guards` — in-jit non-finite update guards folded into
+  every algo's ``train_step`` (``Config.update_guard``).
+- :mod:`tpu_rl.heal.watchdog` — host-side EWMA/z-score divergence detector
+  plus the windowed rollback budget the learner consults before restoring
+  a committed checkpoint (``Config.watchdog_enabled``).
+- :mod:`tpu_rl.heal.ingress` — vectorized finite/range validation of
+  rollout payloads at the storage edge, feeding the per-wid quarantine
+  strike counters on the ``MembershipTable``
+  (``Config.ingress_validate``).
+"""
+
+from tpu_rl.heal.guards import guarded, update_ok
+from tpu_rl.heal.ingress import IngressGuard
+from tpu_rl.heal.watchdog import DivergenceWatchdog, RollbackBudget
+
+__all__ = [
+    "DivergenceWatchdog",
+    "IngressGuard",
+    "RollbackBudget",
+    "guarded",
+    "update_ok",
+]

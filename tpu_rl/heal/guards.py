@@ -1,0 +1,40 @@
+"""In-jit numerical-fault guards for ``train_step`` update loops.
+
+Both helpers trace into the algo's jitted update, so the rules of the
+hot-path checker apply: allocation-free by construction (a scalar ``&``
+and a two-branch ``lax.cond`` whose operands are the already-materialized
+update closures), no Python-level formatting, no containers.
+
+The guard contract every algo implements with these:
+
+- ``cfg.update_guard`` off -> the update code is literally the pre-guard
+  code (bit-identity is pinned per-algo in ``tests/test_heal.py``).
+- guard on, clean step -> ``lax.cond`` takes the apply branch, which
+  computes exactly the ungated ops -> still bit-identical.
+- guard on, non-finite loss or global grad-norm -> the fallback branch
+  returns the *incoming* params/opt state untouched and the step's
+  ``nonfinite-updates`` metric counts one skipped update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def update_ok(loss, gnorm):
+    """Scalar bool: this update's loss and global grad-norm are finite."""
+    return jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+
+def guarded(ok, apply_fn, fallback):
+    """Apply ``apply_fn()`` when ``ok`` else return ``fallback`` untouched.
+
+    ``apply_fn`` is an argless closure over the loop-local grads/state so
+    the taken branch computes exactly the ops the unguarded code would.
+    """
+
+    def _skip():
+        return fallback
+
+    return jax.lax.cond(ok, apply_fn, _skip)

@@ -14,7 +14,7 @@ Grammar (comma-separated clauses)::
                | gauge                     (instantaneous gauge value)
                | counter                   (cumulative counter total)
                | rate                      (counter delta per second)
-    op        := "<" | "<=" | ">" | ">="
+    op        := "<" | "<=" | ">" | ">=" | "=="
     value     := float [unit]   unit := "us" | "ms" | "s" | "/s"
     qualifier := "window=<seconds>s"       (default 60s)
 
@@ -59,10 +59,13 @@ from tpu_rl.obs.registry import hist_quantile
 
 KINDS = frozenset({"p50", "p90", "p99", "p999", "gauge", "counter", "rate"})
 _QUANTILES = {"p50": 0.50, "p90": 0.90, "p99": 0.99, "p999": 0.999}
-# Longest-first so "<=" wins over "<".
+# Longest-first so "<=" wins over "<". "==" is for exact invariants over
+# counters (e.g. counter:learner-nonfinite-updates==0 — any nonfinite
+# update anywhere in the fleet is a violation, not a budget).
 _OPS: tuple[tuple[str, Callable[[float, float], bool]], ...] = (
     ("<=", lambda v, t: v <= t),
     (">=", lambda v, t: v >= t),
+    ("==", lambda v, t: v == t),
     ("<", lambda v, t: v < t),
     (">", lambda v, t: v > t),
 )
@@ -89,8 +92,10 @@ class SloRule:
 
     @property
     def upper_bound(self) -> bool:
-        """True for ``<``-style rules (threshold is a ceiling)."""
-        return self.op.startswith("<")
+        """True for ``<``-style rules (threshold is a ceiling). ``==``
+        counts as a ceiling: exact invariants are worst-cased by the
+        largest source value."""
+        return self.op.startswith("<") or self.op == "=="
 
 
 def _parse_value(clause: str, text: str) -> float:
@@ -127,7 +132,7 @@ def _parse_clause(clause: str) -> SloRule:
             break
     else:
         raise ValueError(
-            f"slo clause {clause!r}: no comparison (expected < <= > >=)"
+            f"slo clause {clause!r}: no comparison (expected < <= > >= ==)"
         )
     metric = metric.strip()
     if not metric:

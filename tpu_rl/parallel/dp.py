@@ -70,7 +70,13 @@ def make_parallel_train_step(
             state, ms = jax.lax.scan(
                 body, state, (batch, jnp.arange(chain))
             )
-            return state, jax.tree.map(lambda x: x[-1], ms)
+            out = jax.tree.map(lambda x: x[-1], ms)
+            if "nonfinite-updates" in ms:
+                # Guard-skip counts are per-update; summing over the chain
+                # axis keeps the dispatched program's count exact (the other
+                # metrics stay last-update snapshots).
+                out["nonfinite-updates"] = jnp.sum(ms["nonfinite-updates"])
+            return state, out
         finally:
             cells.set_data_mesh(prev)
 

@@ -202,6 +202,11 @@ class LearnerService:
         # of waiting out rebroadcast_idle_s.
         self.n_join_pushes = 0
         self._ckpt = None  # Checkpointer while cfg.model_dir is set
+        # Self-healing plane (tpu_rl.heal): cumulative guard-skipped updates
+        # (host mirror of the on-device accumulator, refreshed at the
+        # loss-log cadence) and watchdog-triggered rollbacks performed.
+        self.n_nonfinite_updates = 0.0
+        self.n_rollbacks = 0
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -500,6 +505,30 @@ class LearnerService:
                 f"of learner_chain {chain}; budget rounds DOWN to "
                 f"{self.max_updates // chain * chain} updates", flush=True,
             )
+        # Self-healing plane (tpu_rl.heal): the guards already run inside
+        # train_step (cfg.update_guard, folded in at make_train_step time);
+        # here lives the host side — a lazy on-device accumulator over the
+        # per-dispatch "nonfinite-updates" metric (one jnp add per update,
+        # read back only at the loss-log cadence) plus the divergence
+        # watchdog + rollback budget when enabled. The watchdog needs a
+        # checkpointer to roll back to, so it stays off without model_dir.
+        track_nf = cfg.update_guard
+        nf_acc = 0.0  # device scalar after the first guarded dispatch
+        nf_base = 0.0  # cumulative count at the last rollback (host float)
+        watchdog = budget = None
+        if cfg.watchdog_enabled and ckpt is not None:
+            from tpu_rl.heal import DivergenceWatchdog, RollbackBudget
+
+            watchdog = DivergenceWatchdog(
+                window=cfg.watchdog_window,
+                z_max=cfg.watchdog_z,
+                sustain=cfg.watchdog_sustain,
+                nonfinite_max=cfg.watchdog_nonfinite,
+            )
+            budget = RollbackBudget(
+                max_rollbacks=cfg.max_rollbacks,
+                window_s=cfg.rollback_window_s,
+            )
         # The feed: a background prefetch pipeline (default) or the inline
         # synchronous path (learner_prefetch=0). Either way the loop below
         # pops ONE device-ready dispatch batch per iteration.
@@ -565,6 +594,10 @@ class LearnerService:
                 t_step = time.perf_counter()
                 state, metrics = train_step(state, batch, sub_key)
                 step_secs = time.perf_counter() - t_step
+                if track_nf:
+                    # Lazy device-side add — no host sync per dispatch; the
+                    # loss-log branch below reads it back with float().
+                    nf_acc = nf_acc + metrics["nonfinite-updates"]
                 if self._perf is not None:
                     # The dispatch critical path (same window as the
                     # learner-throughput timer) drives achieved FLOPs/s.
@@ -645,6 +678,56 @@ class LearnerService:
                     logger.flush()
                     if tracer is not None:
                         tracer.dump(os.path.join(cfg.result_dir, "trace.json"))
+                    if track_nf:
+                        # metrics is already host-synced (block_until_ready
+                        # above), so this read costs nothing extra.
+                        self.n_nonfinite_updates = float(nf_acc)
+                    if watchdog is not None:
+                        sa_h = self.stat_array
+                        signals = {
+                            "loss": float(metrics["loss"]),
+                            "grad-norm": float(metrics.get("grad-norm", 0.0)),
+                        }
+                        if (
+                            sa_h is not None
+                            and len(sa_h) > SLOT_MEAN_REW
+                            and sa_h[SLOT_GAME_COUNT] > 0
+                        ):
+                            signals["mean-return"] = float(sa_h[SLOT_MEAN_REW])
+                        tripped = watchdog.observe(signals)
+                        # The guards contained these updates (params never
+                        # touched), but a sustained NaN stream means the data
+                        # or optimizer state is poisoned — count since the
+                        # last rollback, trip immediately at the threshold.
+                        if watchdog.note_nonfinite(
+                            self.n_nonfinite_updates - nf_base
+                        ):
+                            tripped = True
+                        if tripped:
+                            if budget.exhausted():
+                                print(
+                                    f"[learner] rollback budget exhausted "
+                                    f"({budget.used}/{cfg.max_rollbacks} in "
+                                    f"{cfg.rollback_window_s:.0f}s): "
+                                    f"{watchdog.last_reason}; stopping "
+                                    f"cleanly", flush=True,
+                                )
+                                break
+                            rolled = self._rollback(
+                                ckpt, state, mesh, pub, fingerprint, key,
+                                watchdog.last_reason,
+                            )
+                            if rolled is not None:
+                                state, idx, key = rolled
+                                last_pub_m = time.monotonic()
+                                watchdog.reset()
+                                nf_base = self.n_nonfinite_updates
+                                budget.record()
+                                # Skip this iteration's save branch: the
+                                # restored index is already committed on
+                                # disk, re-saving it would race the
+                                # just-finished restore.
+                                continue
                 if ckpt is not None and _crossed(
                     prev_idx, idx, cfg.model_save_interval
                 ):
@@ -893,6 +976,89 @@ class LearnerService:
             timer.record("learner-ckpt-time", dur)
         timer.record_gauge("learner-ckpt-pending", float(ckpt.pending))
 
+    def _rollback(
+        self, ckpt, state, mesh, pub, fingerprint, key, reason: str
+    ):
+        """Watchdog-triggered restore of the PREVIOUS committed checkpoint
+        (the newest may already contain the divergence). Bumps the run
+        epoch so every in-flight pre-rollback rollout is fenced by storage
+        exactly like post-crash frames, rebroadcasts the restored weights,
+        and appends an audit record. Returns (state, idx, key) or None when
+        nothing committed exists to restore."""
+        import jax
+        import jax.numpy as jnp
+
+        # Drain in-flight async saves first: a save committing AFTER
+        # discard_above would resurrect the diverged window on the next
+        # newest-wins resume.
+        ckpt.flush()
+        restored = ckpt.restore_nth_latest(
+            state, n=2, fingerprint=fingerprint, force=self.cfg.resume_force
+        )
+        if restored is None:
+            print(
+                f"[learner] watchdog tripped ({reason}) but no committed "
+                "checkpoint exists to roll back to; continuing", flush=True,
+            )
+            return None
+        state, r_idx, meta = restored
+        ckpt.discard_above(r_idx)
+        if mesh is not None:
+            from tpu_rl.parallel.dp import replicate
+
+            state = replicate(state, mesh)
+        key_data = meta.get("key")
+        if key_data is not None:
+            try:
+                key = jax.random.wrap_key_data(
+                    jnp.asarray(key_data, dtype=jnp.uint32)
+                )
+            except (TypeError, ValueError):
+                pass  # keep the live stream; the restore itself still holds
+        # Epoch fence: every rollout produced against the rolled-back
+        # policy (or assembled from pre-rollback frames) is now stale by
+        # construction — same mechanism as the post-crash resume fence.
+        self.run_epoch += 1
+        sa = self.stat_array
+        if sa is not None and len(sa) > SLOT_RUN_EPOCH:
+            sa[SLOT_RUN_EPOCH] = float(self.run_epoch + 1)  # 0 = unknown
+        self._publish(pub, state, ver=r_idx)
+        self.n_rollbacks += 1
+        self._record_rollback(r_idx, reason)
+        print(
+            f"[learner] rollback #{self.n_rollbacks}: {reason}; restored "
+            f"committed idx {r_idx}, run epoch -> {self.run_epoch}",
+            flush=True,
+        )
+        return state, r_idx, key
+
+    def _record_rollback(self, idx: int, reason: str) -> None:
+        """Append one rollback record to result_dir/learner_rollback.jsonl —
+        the audit trail heal-smoke asserts against (same contract as
+        :meth:`_record_resume`)."""
+        if self.cfg.result_dir is None:
+            return
+        import json
+
+        try:
+            os.makedirs(self.cfg.result_dir, exist_ok=True)
+            path = os.path.join(self.cfg.result_dir, "learner_rollback.jsonl")
+            with open(path, "a") as f:
+                f.write(
+                    json.dumps(
+                        {
+                            "idx": idx,
+                            "epoch": self.run_epoch,
+                            "reason": reason,
+                            "nonfinite": self.n_nonfinite_updates,
+                            "t": time.time(),
+                        }
+                    )
+                    + "\n"
+                )
+        except OSError:
+            pass  # durability bookkeeping must never kill the learner
+
     def _record_resume(self, idx: int) -> None:
         """Append one resume record to result_dir/learner_resume.jsonl —
         the audit trail resume-smoke asserts monotonicity against (child
@@ -927,6 +1093,14 @@ class LearnerService:
         reg.counter("learner-rebroadcasts").set_total(self.n_rebroadcasts)
         reg.gauge("learner-run-epoch").set(self.run_epoch)
         reg.counter("learner-join-pushes").set_total(self.n_join_pushes)
+        # Self-healing plane: exported whenever the guards are compiled in
+        # (update_guard default-on), so the shipped SLO example rule
+        # `counter:learner-nonfinite-updates==0` always has data.
+        if self.cfg.update_guard:
+            reg.counter("learner-nonfinite-updates").set_total(
+                self.n_nonfinite_updates
+            )
+        reg.counter("learner-rollbacks").set_total(self.n_rollbacks)
         perf = self._perf
         if perf is not None:
             # Performance plane: analytical FLOPs per dispatch, achieved

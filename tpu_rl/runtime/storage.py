@@ -65,6 +65,14 @@ class MembershipTable:
         self.active: dict[int, float] = {}  # wid -> last-seen monotonic
         self.n_joined = 0
         self.n_evicted = 0
+        # Quarantine plane (tpu_rl.heal): per-wid poisoned-frame strikes and
+        # the quarantined set (wid -> last-strike monotonic). A quarantined
+        # wid keeps its LEASE (it is alive, just untrusted) — its rollout
+        # frames are dropped at the ingress edge until a clean re-probe.
+        self.strikes: dict[int, int] = {}
+        self.quarantined: dict[int, float] = {}
+        self.n_quarantines = 0
+        self.n_unquarantines = 0
 
     def touch(self, wid: int, now: float | None = None) -> bool:
         """Renew wid's lease; True iff this is a (re)join."""
@@ -82,6 +90,39 @@ class MembershipTable:
             del self.active[w]
             self.n_evicted += 1
         return dead
+
+    # ------------------------------------------------- quarantine (hot path)
+    def strike(self, wid: int, limit: int, now: float | None = None) -> bool:
+        """One poisoned frame from wid; True iff this strike quarantines it.
+        An already-quarantined wid refreshes its last-strike time (the
+        clean-re-probe cooldown restarts)."""
+        now = self._clock() if now is None else now
+        self.strikes[wid] = self.strikes.get(wid, 0) + 1
+        if wid in self.quarantined:
+            self.quarantined[wid] = now
+            return False
+        if self.strikes[wid] >= limit:
+            self.quarantined[wid] = now
+            self.n_quarantines += 1
+            return True
+        return False
+
+    def is_quarantined(self, wid: int) -> bool:
+        return wid in self.quarantined
+
+    def probe_clear(
+        self, wid: int, cooldown: float, now: float | None = None
+    ) -> bool:
+        """A CLEAN frame arrived from a quarantined wid: clear the
+        quarantine (and its strikes) iff the last poisoned frame is at
+        least ``cooldown`` seconds old. True = cleared, frame admissible."""
+        now = self._clock() if now is None else now
+        if now - self.quarantined[wid] >= cooldown:
+            del self.quarantined[wid]
+            self.strikes[wid] = 0
+            self.n_unquarantines += 1
+            return True
+        return False
 
 
 class LearnerStorage:
@@ -158,6 +199,15 @@ class LearnerStorage:
             from tpu_rl.chaos import maybe_transport_chaos
 
             self._chaos = maybe_transport_chaos(cfg, "storage")
+        # Ingress validation (tpu_rl.heal): finite/range checks over each
+        # RolloutBatch's obs/rew columns BEFORE the epoch fence, feeding the
+        # membership table's per-wid quarantine strikes. None when off — the
+        # ingest path then pays one `is None` check per frame.
+        self._ingress = None
+        if cfg.ingress_validate:
+            from tpu_rl.heal.ingress import IngressGuard
+
+            self._ingress = IngressGuard(abs_max=cfg.ingress_abs_max)
 
     def run(self) -> None:
         cfg = self.cfg
@@ -341,6 +391,27 @@ class LearnerStorage:
         reg.gauge("fleet-min-active-version").set(
             self.replicas.min_active_version()
         )
+        if self._ingress is not None:
+            # Self-healing plane: poisoned (failed validation) and
+            # quarantined (clean but from a quarantined wid) frame drops
+            # are SEPARATE counters — and separate from n_rejected and
+            # n_stale_epoch — so the chaos injected==poisoned parity is
+            # assertable exactly.
+            reg.counter("storage-poisoned-frames").set_total(
+                self._ingress.n_poisoned
+            )
+            reg.counter("storage-quarantined-frames").set_total(
+                self._ingress.n_quarantined_frames
+            )
+            reg.counter("storage-quarantines").set_total(
+                self.members.n_quarantines
+            )
+            reg.counter("storage-unquarantines").set_total(
+                self.members.n_unquarantines
+            )
+            reg.gauge("storage-wids-quarantined").set(
+                len(self.members.quarantined)
+            )
         if self._chaos is not None:
             reg.counter("chaos-corrupted-frames").set_total(
                 self._chaos.n_corrupted
@@ -411,6 +482,12 @@ class LearnerStorage:
             # still proves its worker is alive (it is mid re-attach), and
             # evicting it would mis-fire a join push when it converges.
             self._touch_member(payload)
+            # Ingress validation BEFORE the epoch fence: a poisoned frame
+            # counts poisoned no matter its epoch, so the chaos plane's
+            # injected == poisoned parity holds exactly and never shares a
+            # frame with n_stale_epoch (or with transport n_rejected).
+            if self._ingress is not None and not self._ingress_admit(payload):
+                return  # poisoned or quarantined: dropped + counted
             if not self._epoch_admit(payload):
                 return  # pre-crash incarnation's rollout: fenced + counted
             if self.aggregator is not None and isinstance(payload, dict):
@@ -452,6 +529,29 @@ class LearnerStorage:
                 if self.clocksync is not None and isinstance(payload, dict):
                     self._clock_sample(payload)
                 self.aggregator.ingest(payload)
+
+    # ---------------------------------------------------- self-healing plane
+    def _ingress_admit(self, payload) -> bool:
+        """True to ingest. Classification is the IngressGuard's; the
+        quarantine lifecycle (strike -> drop -> clean re-probe) and every
+        drop count live here, at one site. A poisoned frame from a
+        quarantined wid still counts poisoned (exact chaos parity), and a
+        clean frame from a quarantined wid is dropped (quarantined-frames)
+        until the cooldown clears it."""
+        guard = self._ingress
+        if guard.tick_clean(payload):
+            wid = payload.get("wid") if isinstance(payload, dict) else None
+            if isinstance(wid, int) and self.members.is_quarantined(wid):
+                if self.members.probe_clear(wid, self.cfg.quarantine_clear_s):
+                    return True
+                guard.n_quarantined_frames += 1
+                return False
+            return True
+        guard.n_poisoned += 1
+        wid = payload.get("wid") if isinstance(payload, dict) else None
+        if isinstance(wid, int):
+            self.members.strike(wid, self.cfg.quarantine_strikes)
+        return False
 
     # ----------------------------------------------------- durability plane
     def _poll_epoch(self) -> None:

@@ -166,13 +166,19 @@ class Worker:
         cfg = self.cfg
         manager_ip, manager_port, learner_ip, model_port = self.addr
         # Fault injection (tpu_rl.chaos): delay:worker shims this worker's
-        # sends, corrupt/drop:model its model-SUB receives. None unless a
-        # chaos_spec names this site.
+        # sends, corrupt/drop:model its model-SUB receives; nan:/spike:
+        # poison rollout payload VALUES pre-send (wire stays CRC-valid —
+        # the self-healing plane must contain them). None unless a
+        # chaos_spec names this site / this worker instance.
         chaos = None
+        dchaos = None
         if cfg.chaos_spec:
-            from tpu_rl.chaos import maybe_transport_chaos
+            from tpu_rl.chaos import maybe_data_chaos, maybe_transport_chaos
 
             chaos = maybe_transport_chaos(
+                cfg, "worker", instance=self.worker_id
+            )
+            dchaos = maybe_data_chaos(
                 cfg, "worker", instance=self.worker_id
             )
         pub = Pub(manager_ip, manager_port, bind=False, chaos=chaos)
@@ -478,25 +484,24 @@ class Worker:
                     if sampled
                     else None
                 )
-                pub.send(
-                    Protocol.RolloutBatch,
-                    dict(
-                        obs=tick_obs,
-                        act=a_np,
-                        rew=rews,
-                        logits=logits_np,
-                        log_prob=lp_np,
-                        is_fir=tick_fir[:, None],
-                        hx=h_np if family.store_carry else hx_stub,
-                        cx=c_np if family.store_carry else cx_stub,
-                        id=tick_ids,
-                        done=dones,
-                        wid=self.worker_id,
-                        ver=tick_ver,
-                        epoch=run_epoch,
-                    ),
-                    trace=trailer,
+                tick_payload = dict(
+                    obs=tick_obs,
+                    act=a_np,
+                    rew=rews,
+                    logits=logits_np,
+                    log_prob=lp_np,
+                    is_fir=tick_fir[:, None],
+                    hx=h_np if family.store_carry else hx_stub,
+                    cx=c_np if family.store_carry else cx_stub,
+                    id=tick_ids,
+                    done=dones,
+                    wid=self.worker_id,
+                    ver=tick_ver,
+                    epoch=run_epoch,
                 )
+                if dchaos is not None:
+                    dchaos.on_tick(tick_payload)
+                pub.send(Protocol.RolloutBatch, tick_payload, trace=trailer)
                 if sampled and tracer is not None:
                     tracer.add(
                         "worker-tick",
@@ -571,6 +576,16 @@ class Worker:
                         registry.counter(
                             "chaos-delayed-frames"
                         ).set_total(chaos.n_delayed)
+                    if dchaos is not None:
+                        registry.counter(
+                            "chaos-nan-injected"
+                        ).set_total(dchaos.n_nan)
+                        registry.counter(
+                            "chaos-spike-injected"
+                        ).set_total(dchaos.n_spike)
+                        registry.counter(
+                            "chaos-logp-nan-injected"
+                        ).set_total(dchaos.n_logp_nan)
                     if emitter.due():
                         # /proc self-stats only just before an emit — the
                         # reads cost syscalls, the gauges only travel then.
