@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
-	resume-smoke slo-smoke loadgen-smoke heal-smoke ci
+	resume-smoke slo-smoke loadgen-smoke heal-smoke pbt-smoke ci
 
 lint:
 	ruff check .
@@ -118,6 +118,14 @@ loadgen-smoke:
 heal-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/heal_smoke.py
 
+# Population smoke: K=4 colocated CartPole variants under the PBT
+# controller, one poisoned (lr ~100x) — assert the poisoned variant is
+# truncation-replaced (winner checkpoint adopted + hyperparameters
+# mutated), a SIGKILL mid-exploit leaves the member resumable, and the
+# final leaderboard's best fitness clears the CartPole bar.
+pbt-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/pbt_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
-	loadgen-smoke heal-smoke
+	loadgen-smoke heal-smoke pbt-smoke

@@ -1,0 +1,246 @@
+"""PBT smoke: K=4 colocated CartPole variants under the population
+controller, with one deliberately poisoned variant — the ``make pbt-smoke``
+CI gate for the population plane (seeded sampling, telemetry scraping,
+truncation selection, exploit/explore checkpoint adoption, kill-resumability).
+
+Sequence:
+
+1. boot ``PopulationController`` over K=4 colocated members with a seeded
+   lr/entropy search space; member 0's lr is overridden to ~100x the
+   known-good value (a variant PBT must weed out);
+2. the controller evals every ``interval`` member updates: the poisoned
+   member must show up as a truncation loser and be exploit-replaced
+   (winner checkpoint copied, hyperparameters adopted + mutated, epoch
+   bumped, member restarted);
+3. the harness SIGKILLs the first exploited member right after its exploit
+   restart — mid-adoption, before it has produced anything of its own. The
+   supervisor must respawn it and the respawn must resume from the COPIED
+   committed checkpoint (two-phase commit preserved across the copy);
+4. assert the final leaderboard's best fitness clears the CartPole bar,
+   the audit trail has the expected spawn/eval/exploit/respawn events,
+   every surviving checkpoint dir is committed, and the run exits 0.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/pbt_smoke.py \
+      [--updates 1500] [--timeout 600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POISON_LR = 0.03  # ~100x the known-good 3e-4: reliably cripples PPO CartPole
+FITNESS_BAR = 60.0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--updates", type=int, default=1500)
+    p.add_argument("--timeout", type=float, default=600.0)
+    args = p.parse_args()
+
+    from tpu_rl.checkpoint import COMMIT_MARKER, _ckpt_dirs, is_committed
+    from tpu_rl.config import Config
+    from tpu_rl.population import PopulationController
+
+    run_dir = tempfile.mkdtemp(prefix="pbt_smoke_")
+    cfg = Config(
+        env="CartPole-v1",
+        env_mode="colocated",
+        algo="PPO",
+        batch_size=32,
+        buffer_size=32,
+        seq_len=5,
+        lr=3e-4,
+        entropy_coef=0.001,
+        reward_scale=0.1,
+        time_horizon=500,
+        loss_log_interval=100,
+        model_save_interval=100,
+        ckpt_keep=3,
+        learner_device="cpu",
+        result_dir=run_dir,
+        telemetry_interval_s=0.5,
+        telemetry_stale_s=120.0,
+        supervise_poll_s=0.25,
+        startup_grace_s=180.0,
+        heartbeat_timeout_s=90.0,
+        # Search space centered on the known-good colocated CartPole recipe;
+        # eval every 300 member updates -> ~4 generations in a 1500-update
+        # budget, first eval after every member has committed checkpoints.
+        pop_spec=(
+            "lr:log[1e-4,1e-3] entropy_coef:lin[0.0005,0.002] "
+            "perturb=1.2,0.8 interval=300u quantile=0.25 k=4"
+        ),
+        pop_seed=7,
+    )
+
+    # The SIGKILL-mid-exploit probe: the 'exploit' audit event carries the
+    # restarted member's fresh pid — kill it on the spot, before it has
+    # resumed, and let the supervisor's ordinary crash respawn prove the
+    # copied checkpoint is whole and adoptable.
+    probe = {"killed_member": None, "exploited": []}
+
+    def on_event(ev: dict) -> None:
+        if ev.get("ev") != "exploit":
+            return
+        probe["exploited"].append(ev)
+        if probe["killed_member"] is None:
+            probe["killed_member"] = ev["loser"]
+            print(
+                f"[pbt-smoke] SIGKILL member-{ev['loser']} mid-exploit "
+                f"(pid {ev['pid']})", flush=True,
+            )
+            os.kill(ev["pid"], signal.SIGKILL)
+
+    ctrl = PopulationController(
+        cfg,
+        max_updates=args.updates,
+        initial_values={0: {"lr": POISON_LR}},
+        on_event=on_event,
+    )
+    print(
+        f"[pbt-smoke] population up; run_dir={run_dir} "
+        f"poisoned member-0 lr={POISON_LR}", flush=True,
+    )
+    # Watchdog: a hung population must fail the gate, not wedge CI.
+    watchdog = threading.Timer(args.timeout, ctrl.sup.stop_event.set)
+    watchdog.daemon = True
+    watchdog.start()
+    t0 = time.monotonic()
+    doc = ctrl.run()
+    watchdog.cancel()
+    print(
+        f"[pbt-smoke] run finished in {time.monotonic() - t0:.0f}s "
+        f"ok={doc['ok']} counts={doc['counts']}", flush=True,
+    )
+
+    failures: list[str] = []
+    if not doc["ok"]:
+        failures.append(
+            "population run did not complete cleanly (timeout, exhausted "
+            "restart budget, or external stop)"
+        )
+
+    # ---- audit trail: the poisoned member was weeded out ----
+    events = []
+    try:
+        with open(os.path.join(run_dir, "population.jsonl")) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+    except (OSError, ValueError) as e:
+        failures.append(f"population.jsonl unreadable: {type(e).__name__}: {e}")
+    by_ev: dict[str, int] = {}
+    for ev in events:
+        by_ev[ev.get("ev", "?")] = by_ev.get(ev.get("ev", "?"), 0) + 1
+    print(f"[pbt-smoke] audit events: {by_ev}", flush=True)
+    exploits = [ev for ev in events if ev.get("ev") == "exploit"]
+    if not exploits:
+        failures.append("no exploit event: truncation selection never fired")
+    elif not any(ev["loser"] == 0 for ev in exploits):
+        failures.append(
+            "poisoned member-0 was never truncation-replaced "
+            f"(losers: {sorted({ev['loser'] for ev in exploits})})"
+        )
+    if by_ev.get("eval", 0) < 1:
+        failures.append("no eval event: generation boundary never reached")
+
+    # ---- kill-resumability: the SIGKILLed member came back and resumed ----
+    killed = probe["killed_member"]
+    if killed is None:
+        failures.append("SIGKILL probe never armed (no exploit happened)")
+    else:
+        respawns = [
+            ev for ev in events
+            if ev.get("ev") == "respawn" and ev.get("member") == f"member-{killed}"
+        ]
+        if not respawns:
+            failures.append(
+                f"supervisor never respawned SIGKILLed member-{killed}"
+            )
+        resume_path = os.path.join(
+            run_dir, f"member-{killed}", "learner_resume.jsonl"
+        )
+        try:
+            with open(resume_path) as f:
+                recs = [json.loads(line) for line in f if line.strip()]
+        except (OSError, ValueError):
+            recs = []
+        if not recs:
+            failures.append(
+                f"member-{killed} wrote no resume record after the "
+                "mid-exploit SIGKILL — the copied checkpoint was not adopted"
+            )
+        else:
+            last = recs[-1]
+            if int(last["epoch"]) < 1:
+                failures.append(
+                    f"member-{killed} resumed without an epoch bump: {last}"
+                )
+            print(
+                f"[pbt-smoke] member-{killed} resumed at idx {last['idx']}, "
+                f"run epoch {last['epoch']} ({len(recs)} resume(s))",
+                flush=True,
+            )
+
+    # ---- leaderboard: someone actually solved the task ----
+    try:
+        final = json.loads(
+            open(os.path.join(run_dir, "population.json")).read()
+        )
+    except (OSError, ValueError) as e:
+        failures.append(f"population.json invalid: {type(e).__name__}: {e}")
+        final = {"leaderboard": []}
+    board = final.get("leaderboard", [])
+    if board != sorted(
+        board,
+        key=lambda r: -(r["best_fitness"] if r["best_fitness"] is not None
+                        else float("-inf")),
+    ):
+        failures.append("leaderboard is not sorted best-first")
+    best = board[0] if board else None
+    if best is None or best["best_fitness"] is None:
+        failures.append("empty leaderboard / no fitness readings")
+    elif best["best_fitness"] < FITNESS_BAR:
+        failures.append(
+            f"best fitness {best['best_fitness']:.1f} < {FITNESS_BAR:.0f} — "
+            "the population never solved CartPole"
+        )
+    else:
+        print(
+            f"[pbt-smoke] best member-{best['member']} "
+            f"fitness {best['best_fitness']:.1f} values {best['values']}",
+            flush=True,
+        )
+
+    # ---- durability: every surviving checkpoint dir is committed ----
+    for k in range(ctrl.spec.k):
+        models = os.path.join(run_dir, f"member-{k}", "models")
+        if not os.path.isdir(models):
+            failures.append(f"member-{k} has no models dir")
+            continue
+        for _idx, path in _ckpt_dirs(models, "PPO"):
+            if not is_committed(path):
+                failures.append(
+                    f"uncommitted checkpoint survived: {path} (no "
+                    f"{COMMIT_MARKER} marker)"
+                )
+
+    if failures:
+        for f in failures:
+            print(f"[pbt-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[pbt-smoke] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

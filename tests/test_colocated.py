@@ -246,3 +246,41 @@ def test_colocated_loop_run_emits_metrics(tmp_path):
         "colocated-env-steps-per-s", "colocated-scan-chunk-s",
     ):
         assert name in payload, f"metric {name} missing from telemetry.json"
+
+
+def test_colocated_checkpoint_resume(tmp_path):
+    """PR 14: the fused loop checkpoints like the distributed learner —
+    committed saves every model_save_interval, resume continues at the
+    saved update index with a bumped run epoch (the PBT member contract:
+    an exploit restart is exactly this resume path)."""
+    import json as _json
+
+    from tpu_rl.checkpoint import latest_committed, read_meta
+
+    cfg = _cfg(
+        result_dir=str(tmp_path),
+        model_dir=str(tmp_path / "models"),
+        model_save_interval=5,
+        ckpt_keep=3,
+        ckpt_async=False,
+    )
+    loop = ColocatedLoop(cfg, seed=0, max_updates=10)
+    loop.run(log=False)
+    loop.close()
+    first = latest_committed(str(tmp_path / "models"), "PPO")
+    assert first is not None and first[0] == 10
+    assert int(read_meta(first[1])["epoch"]) == 0
+
+    loop2 = ColocatedLoop(cfg, seed=0, max_updates=20)
+    out = loop2.run(log=False)
+    loop2.close()
+    assert loop2._start_it == 10, "resume did not pick the committed save"
+    assert loop2.run_epoch == 1, "resume did not bump the run epoch"
+    assert out["updates"] == 20
+    second = latest_committed(str(tmp_path / "models"), "PPO")
+    assert second is not None and second[0] == 20
+    assert int(read_meta(second[1])["epoch"]) == 1
+
+    with open(tmp_path / "learner_resume.jsonl") as f:
+        recs = [_json.loads(line) for line in f if line.strip()]
+    assert [(r["idx"], r["epoch"]) for r in recs] == [(10, 1)]

@@ -1,0 +1,322 @@
+"""Population plane (``tpu_rl/population``): search-space grammar,
+deterministic sampling/mutation, truncation selection, exploit checkpoint
+adoption, and the leaderboard/lineage documents."""
+
+import json
+import os
+
+import pytest
+
+from tpu_rl import checkpoint as ck
+from tpu_rl.config import Config
+from tpu_rl.population import (
+    PopSpec,
+    fold_in,
+    member_seed,
+    mutate,
+    sample_member,
+    truncation_select,
+)
+from tpu_rl.population.controller import (
+    MemberState,
+    flatten_telemetry,
+    population_doc,
+)
+
+SPEC = "lr:log[1e-4,1e-2] entropy_coef:lin[0,0.05] perturb=1.2,0.8 interval=200u k=4"
+
+
+# --------------------------------------------------------------------- grammar
+class TestSpecGrammar:
+    def test_full_clause_set(self):
+        spec = PopSpec.parse(
+            "lr:log[1e-4,1e-2]; entropy_coef:lin[0,0.05] "
+            "perturb=1.3,0.7 interval=30s quantile=0.5 k=8 fitness=my-gauge"
+        )
+        assert [d.field for d in spec.dims] == ["lr", "entropy_coef"]
+        assert spec.dims[0].kind == "log"
+        assert spec.perturb == (1.3, 0.7)
+        assert (spec.interval, spec.interval_unit) == (30.0, "s")
+        assert spec.quantile == 0.5
+        assert spec.k == 8
+        assert spec.fitness == "my-gauge"
+
+    def test_choice_dim(self):
+        spec = PopSpec.parse("seq_len:choice[5,10,20]")
+        assert spec.dims[0].choices == (5.0, 10.0, 20.0)
+
+    def test_defaults(self):
+        spec = PopSpec.parse("lr:log[1e-4,1e-2]")
+        assert spec.k == 4
+        assert spec.perturb == (1.2, 0.8)
+        assert (spec.interval, spec.interval_unit) == (200.0, "u")
+        assert spec.quantile == 0.25
+
+    @pytest.mark.parametrize(
+        "text, msg",
+        [
+            ("", "empty pop spec"),
+            ("perturb=1.2,0.8", "no sampled dimension"),
+            ("lr:log[1e-4]", "exactly"),
+            ("lr:log[0,1e-2]", "lo > 0"),
+            ("lr:lin[2,1]", "lo < hi"),
+            ("lr:geo[1,2]", "unknown kind"),
+            ("lr:choice[5]", ">= 2 values"),
+            ("lr:log[1e-4,1e-2] lr:lin[0,1]", "sampled twice"),
+            ("lr:log[1e-4,1e-2] perturb=0,1", "> 0"),
+            ("lr:log[1e-4,1e-2] interval=10x", "interval needs a unit"),
+            ("lr:log[1e-4,1e-2] quantile=0.9", "quantile"),
+            ("lr:log[1e-4,1e-2] k=1", "k >= 2"),
+            ("lr:log[1e-4,1e-2] bogus=3", "unknown knob"),
+        ],
+    )
+    def test_error_matrix(self, text, msg):
+        with pytest.raises(ValueError, match=msg):
+            PopSpec.parse(text)
+
+    def test_unsearchable_field_rejected(self):
+        spec = PopSpec.parse("env:log[1,2]")
+        with pytest.raises(ValueError, match="searchable"):
+            spec.check_searchable()
+
+    def test_config_validate_parses_spec(self):
+        # Same fail-at-load contract as chaos_spec: validate() (the
+        # from_dict/replace gate) rejects a typo'd grammar.
+        with pytest.raises(ValueError, match="exactly"):
+            Config(env="CartPole-v1", pop_spec="lr:log[1e-4]").validate()
+        with pytest.raises(ValueError, match="searchable"):
+            Config(env="CartPole-v1", pop_spec="env:lin[0,1]").validate()
+        cfg = Config(env="CartPole-v1", pop_spec=SPEC)
+        cfg.validate()
+        assert cfg.replace(pop_seed=3).pop_spec == SPEC
+
+
+# --------------------------------------------------------- seeded determinism
+class TestDeterminism:
+    def test_fold_in_stable_and_distinct(self):
+        assert fold_in(7, 1, 2) == fold_in(7, 1, 2)
+        assert fold_in(7, 1, 2) != fold_in(7, 2, 1)
+        assert fold_in(7, 1) != fold_in(8, 1)
+
+    def test_member_seed_pinned(self):
+        # The derivation is part of the reproducibility contract: the same
+        # (pop_seed, idx) must land on the same member seed in any session.
+        seeds = [member_seed(0, i) for i in range(4)]
+        assert seeds == [1627376989, 1800489502, 1998321373, 558460563]
+        assert all(0 <= s < 2**31 for s in seeds)
+
+    def test_sampling_deterministic_in_bounds(self):
+        spec = PopSpec.parse(SPEC)
+        for idx in range(4):
+            a = sample_member(spec, 3, idx)
+            assert a == sample_member(spec, 3, idx)
+            assert 1e-4 <= a["lr"] <= 1e-2
+            assert 0.0 <= a["entropy_coef"] <= 0.05
+        assert sample_member(spec, 3, 0) != sample_member(spec, 3, 1)
+        assert sample_member(spec, 3, 0) != sample_member(spec, 4, 0)
+
+    def test_int_dims_cast(self):
+        # time_horizon: a searchable int field (seq_len is structural —
+        # fingerprinted — so it casts nothing and check_searchable rejects it)
+        spec = PopSpec.parse("time_horizon:choice[100,200,300]")
+        spec.check_searchable()
+        v = sample_member(spec, 0, 0)
+        assert isinstance(v["time_horizon"], int)
+        assert v["time_horizon"] in (100, 200, 300)
+
+    def test_mutation_deterministic_perturbed_clamped(self):
+        spec = PopSpec.parse(SPEC)
+        base = {"lr": 1e-3, "entropy_coef": 0.01}
+        m = mutate(spec, base, 3, 1, 0)
+        assert m == mutate(spec, base, 3, 1, 0)
+        assert m != mutate(spec, base, 3, 1, 1)  # generation folds in
+        assert any(m["lr"] == pytest.approx(x) for x in (1.2e-3, 0.8e-3))
+        top = mutate(spec, {"lr": 1e-2, "entropy_coef": 0.05}, 3, 1, 0)
+        assert top["lr"] <= 1e-2  # clamp at hi
+        assert top["entropy_coef"] <= 0.05
+
+
+# --------------------------------------------------------- truncation selection
+class TestTruncationSelection:
+    def test_quarter_of_four(self):
+        losers, winners = truncation_select({0: 1.0, 1: 5.0, 2: 3.0, 3: 0.5}, 0.25)
+        assert (losers, winners) == ([3], [1])
+
+    def test_half_of_four(self):
+        losers, winners = truncation_select({0: 1.0, 1: 5.0, 2: 3.0, 3: 0.5}, 0.5)
+        assert losers == [3, 0]
+        assert winners == [1, 2]  # best first
+
+    def test_small_populations_never_overlap(self):
+        assert truncation_select({0: 1.0}, 0.5) == ([], [])
+        assert truncation_select({}, 0.5) == ([], [])
+        losers, winners = truncation_select({0: 1.0, 1: 2.0, 2: 3.0}, 0.5)
+        assert len(losers) == 1 and len(winners) == 1
+        assert not set(losers) & set(winners)
+
+    def test_ties_break_deterministically(self):
+        a = truncation_select({0: 1.0, 1: 1.0, 2: 1.0, 3: 1.0}, 0.25)
+        assert a == truncation_select({0: 1.0, 1: 1.0, 2: 1.0, 3: 1.0}, 0.25)
+
+
+# --------------------------------------------------------- checkpoint adoption
+def _fake_committed(model_dir, algo, idx, meta):
+    """An orbax-shaped committed dir without orbax: copy_committed and the
+    marker protocol are pure file I/O, so a plain payload file suffices."""
+    path = os.path.join(model_dir, f"{algo}_{idx}")
+    os.makedirs(os.path.join(path, "tree"))
+    with open(os.path.join(path, "tree", "payload"), "w") as f:
+        f.write(f"weights-{algo}-{idx}")
+    marker = os.path.join(path, ck.COMMIT_MARKER)
+    with open(marker, "w") as f:
+        json.dump({**meta, "idx": idx}, f)
+    return path
+
+
+class TestCopyCommitted:
+    def test_copy_preserves_payload_and_rewrites_meta(self, tmp_path):
+        src_dir = tmp_path / "winner"
+        dst_dir = tmp_path / "loser"
+        src = _fake_committed(str(src_dir), "PPO", 300, {"epoch": 4, "fp": "ab"})
+        dst = ck.copy_committed(
+            src, str(dst_dir), "PPO", 301, {"epoch": 9, "pop": {"winner": 2}}
+        )
+        assert ck.is_committed(dst)
+        meta = ck.read_meta(dst)
+        assert meta["idx"] == 301  # idx override always wins
+        assert meta["epoch"] == 9
+        assert meta["pop"] == {"winner": 2}
+        assert meta["fp"] == "ab"  # untouched source meta carries over
+        with open(os.path.join(dst, "tree", "payload")) as f:
+            assert f.read() == "weights-PPO-300"
+        assert ck.latest_committed(str(dst_dir), "PPO") == (301, dst)
+
+    def test_uncommitted_source_refused(self, tmp_path):
+        src = _fake_committed(str(tmp_path / "w"), "PPO", 5, {})
+        os.remove(os.path.join(src, ck.COMMIT_MARKER))
+        with pytest.raises(ValueError, match="not committed"):
+            ck.copy_committed(src, str(tmp_path / "l"), "PPO", 6)
+
+    def test_torn_copy_invisible_to_readers(self, tmp_path, monkeypatch):
+        """A crash between tree copy and marker placement must leave the
+        destination resumable from ITS OWN previous committed checkpoint."""
+        loser_dir = tmp_path / "loser"
+        own = _fake_committed(str(loser_dir), "PPO", 100, {"epoch": 1})
+        src = _fake_committed(str(tmp_path / "winner"), "PPO", 300, {"epoch": 4})
+
+        real_replace = os.replace
+
+        def crash_on_marker(a, b):
+            if os.path.basename(b) == ck.COMMIT_MARKER:
+                raise OSError("SIGKILL mid-copy")
+            return real_replace(a, b)
+
+        monkeypatch.setattr(ck.os, "replace", crash_on_marker)
+        with pytest.raises(OSError):
+            ck.copy_committed(src, str(loser_dir), "PPO", 301, {"epoch": 9})
+        monkeypatch.undo()
+        torn = os.path.join(str(loser_dir), "PPO_301")
+        assert os.path.isdir(torn) and not ck.is_committed(torn)
+        # newest COMMITTED is still the loser's own pre-exploit checkpoint
+        assert ck.latest_committed(str(loser_dir), "PPO") == (100, own)
+
+    def test_exploit_epoch_fences_loser_history(self, tmp_path):
+        """The controller stamps marker epoch = loser_epoch + 1 so the
+        resumed run (epoch = marker + 1) is strictly above everything the
+        pre-exploit incarnation produced."""
+        loser_dir = tmp_path / "loser"
+        _fake_committed(str(loser_dir), "PPO", 120, {"epoch": 3})
+        src = _fake_committed(str(tmp_path / "winner"), "PPO", 80, {"epoch": 0})
+        loser_epoch = ck.read_meta(
+            ck.latest_committed(str(loser_dir), "PPO")[1]
+        )["epoch"]
+        # copied idx must beat the loser's newest so resume picks the copy
+        new_idx = max(80, 120 + 1)
+        dst = ck.copy_committed(
+            src, str(loser_dir), "PPO", new_idx, {"epoch": loser_epoch + 1}
+        )
+        assert ck.latest_committed(str(loser_dir), "PPO") == (121, dst)
+        assert ck.read_meta(dst)["epoch"] == 4  # loser 3 + 1, NOT winner 0
+
+
+# ------------------------------------------------------------------ documents
+class TestDocuments:
+    def test_flatten_telemetry_last_wins(self):
+        doc = {
+            "sources": [
+                {"counters": [["colocated-updates", None, 100.0]],
+                 "gauges": [["colocated-mean-episode-return", None, 12.0]]},
+                {"counters": [], "gauges": [
+                    ["colocated-mean-episode-return", None, 30.5]]},
+            ]
+        }
+        flat = flatten_telemetry(doc)
+        assert flat["colocated-updates"] == 100.0
+        assert flat["colocated-mean-episode-return"] == 30.5
+        assert flatten_telemetry({}) == {}
+
+    def test_population_doc_schema(self):
+        a = MemberState(idx=0, dir="/d/0", seed=1, values={"lr": 1e-3})
+        b = MemberState(idx=1, dir="/d/1", seed=2, values={"lr": 2e-3})
+        a.fitness, a.best_fitness = 10.0, 50.0
+        b.fitness = 90.0
+        b.best_fitness = 90.0
+        b.lineage.append({"ev": "exploit", "winner": 0})
+        doc = population_doc([a, b], 3, {"evals": 3, "exploits": 1}, True)
+        assert doc["ok"] is True and doc["generation"] == 3
+        assert [r["member"] for r in doc["leaderboard"]] == [1, 0]  # best first
+        assert doc["leaderboard"][0]["best_fitness"] == 90.0
+        assert doc["lineage"]["1"] == [{"ev": "exploit", "winner": 0}]
+        json.dumps(doc)  # must be directly serializable
+
+    def test_population_doc_no_readings(self):
+        m = MemberState(idx=0, dir="/d/0", seed=1, values={})
+        doc = population_doc([m], 0, {}, False)
+        assert doc["leaderboard"][0]["best_fitness"] is None
+        json.dumps(doc)
+
+
+# ------------------------------------------------------------- config plumbing
+class TestConfigRoundTrip:
+    def test_json_round_trip_equality(self, tmp_path):
+        cfg = Config(
+            env="CartPole-v1", env_mode="colocated", algo="PPO",
+            pop_spec=SPEC, pop_seed=11, seq_len=5, batch_size=32,
+            buffer_size=32,
+        )
+        path = str(tmp_path / "config.json")
+        cfg.to_json(path)
+        assert Config.from_json(path) == cfg
+
+    def test_overrides_beat_file_values(self, tmp_path):
+        cfg = Config(env="CartPole-v1", lr=3e-4, pop_seed=1)
+        path = str(tmp_path / "config.json")
+        cfg.to_json(path)
+        got = Config.from_json(path, lr=9e-4, result_dir=str(tmp_path))
+        assert got.lr == 9e-4
+        assert got.result_dir == str(tmp_path)
+        assert got.pop_seed == 1  # non-overridden file value survives
+
+    def test_tuple_fields_survive_round_trip(self, tmp_path):
+        cfg = Config(env="CartPole-v1", obs_shape=(4,), value_target_clip=(-5.0, 5.0))
+        path = str(tmp_path / "config.json")
+        cfg.to_json(path)
+        got = Config.from_json(path)
+        assert got.obs_shape == (4,)
+        assert got.value_target_clip == (-5.0, 5.0)
+
+    def test_searchable_mutations_are_fingerprint_exempt(self):
+        """PBT may only mutate fields that don't change the train-state
+        structure: a mutated config must resume the winner's checkpoint."""
+        from tpu_rl.checkpoint import resume_fingerprint
+        from tpu_rl.population.spec import searchable_fields
+
+        base = Config(env="CartPole-v1", obs_shape=(4,), action_space=2)
+        fp = resume_fingerprint(base)
+        assert resume_fingerprint(base.replace(lr=0.009)) == fp
+        assert resume_fingerprint(base.replace(entropy_coef=0.04)) == fp
+        assert "lr" in searchable_fields()
+        assert "entropy_coef" in searchable_fields()
+        # structural fields stay out of the searchable registry
+        for banned in ("hidden_size", "seq_len_model", "env", "algo"):
+            assert banned not in searchable_fields()

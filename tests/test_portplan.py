@@ -1,0 +1,96 @@
+"""Shared port planner (``runtime/portplan.py``): the one collision
+authority for inference replica fans AND population member blocks."""
+
+import pytest
+
+from tpu_rl.config import Config, MachinesConfig, WorkerMachine
+from tpu_rl.runtime.portplan import (
+    plan_member_port_blocks,
+    plan_member_telemetry_ports,
+    plan_range,
+    reserved_ports,
+)
+
+
+def _machines(**kw):
+    return MachinesConfig(
+        learner_ip="127.0.0.1",
+        learner_port=kw.pop("learner_port", 40000),
+        workers=[WorkerMachine(num_p=1, port=kw.pop("worker_port", 41000))],
+    )
+
+
+class TestReservedPorts:
+    def test_covers_every_fleet_endpoint(self):
+        m = _machines()
+        cfg = Config(env="CartPole-v1", telemetry_port=42000)
+        owners = reserved_ports(m, cfg)
+        assert owners[40000].startswith("learner_port")
+        assert owners[40001].startswith("model_port")
+        assert owners[41000].startswith("worker")
+        assert owners[42000].startswith("telemetry_port")
+
+    def test_no_cfg_means_fleet_ports_only(self):
+        owners = reserved_ports(_machines())
+        assert set(owners) == {40000, 40001, 41000}
+
+
+class TestPlanRange:
+    def test_clean_range(self):
+        got = plan_range(50000, 3, {40000: "learner_port"}, "inference replica")
+        assert got == [50000, 50001, 50002]
+
+    def test_collision_names_the_owner(self):
+        with pytest.raises(ValueError, match="collides with learner_port"):
+            plan_range(39999, 3, {40000: "learner_port (fan-in)"}, "inference replica")
+
+    def test_out_of_port_space(self):
+        with pytest.raises(ValueError, match="outside the port space"):
+            plan_range(65535, 2, {}, "inference replica")
+        with pytest.raises(ValueError, match="outside the port space"):
+            plan_range(0, 2, {}, "inference replica")
+
+    def test_inference_ports_delegates_here(self):
+        # The MachinesConfig property must keep raising the same shaped
+        # error the fleet tests pin (the satellite dedup must not fork the
+        # message).
+        m = _machines()
+        cfg = Config(
+            env="CartPole-v1",
+            inference_replicas=2,
+            inference_base_port=m.model_port - 1,
+        )
+        with pytest.raises(ValueError, match="collides with"):
+            m.inference_ports(cfg)
+
+
+class TestMemberPorts:
+    def test_telemetry_disabled_propagates_zeros(self):
+        cfg = Config(env="CartPole-v1", telemetry_port=0)
+        assert plan_member_telemetry_ports(_machines(), cfg, 4) == [0, 0, 0, 0]
+
+    def test_telemetry_ports_follow_controller_port(self):
+        cfg = Config(env="CartPole-v1", telemetry_port=42000)
+        got = plan_member_telemetry_ports(_machines(), cfg, 3)
+        assert got == [42001, 42002, 42003]
+
+    def test_telemetry_collision_with_fleet_port(self):
+        cfg = Config(env="CartPole-v1", telemetry_port=39999)
+        with pytest.raises(ValueError, match="collides with learner_port"):
+            plan_member_telemetry_ports(_machines(), cfg, 4)
+
+    def test_member_blocks_are_disjoint_and_clear_of_fleet(self):
+        cfg = Config(env="CartPole-v1", telemetry_port=42000)
+        blocks = plan_member_port_blocks(_machines(), cfg, 3, block=8)
+        assert len(blocks) == 3
+        assert len(set(blocks)) == 3
+        reserved = reserved_ports(_machines(), cfg)
+        tele = plan_member_telemetry_ports(_machines(), cfg, 3)
+        for base in blocks:
+            for port in range(base, base + 8):
+                assert port not in reserved
+                assert port not in tele
+        # blocks do not overlap each other
+        spans = sorted((b, b + 8) for b in blocks)
+        for (_, hi), (lo, _) in zip(spans, spans[1:]):
+            assert hi <= lo

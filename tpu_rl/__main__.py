@@ -24,8 +24,9 @@ from tpu_rl.config import Config, MachinesConfig, default_result_dirs
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu_rl")
     p.add_argument(
-        "role", choices=["local", "learner", "manager", "worker"],
-        help="which role this host runs",
+        "role", choices=["local", "learner", "manager", "worker", "population"],
+        help="which role this host runs ('population' = PBT controller "
+        "orchestrating K member runs; see tpu_rl.population)",
     )
     p.add_argument("--params", help="parameters.json-shaped config file")
     p.add_argument("--machines", help="machines.json-shaped topology file")
@@ -107,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "delay:manager@50ms' (see tpu_rl.chaos.plan)")
     p.add_argument("--chaos-seed", type=int, default=None,
                    help="seed for the chaos plane's per-site RNG streams")
+    p.add_argument("--pop-spec", default=None,
+                   help="PBT search-space grammar for the population role, "
+                   "e.g. 'lr:log[1e-4,1e-2] entropy_coef:lin[0,0.05] "
+                   "perturb=1.2,0.8 interval=200u k=4' "
+                   "(see tpu_rl.population.spec)")
+    p.add_argument("--pop-seed", type=int, default=None,
+                   help="seed for population sampling/mutation/selection "
+                   "(deterministic per-member streams)")
     p.add_argument("--heartbeat-timeout", type=float, default=None,
                    help="seconds of child-heartbeat silence before the "
                    "supervisor declares it hung and restarts it")
@@ -154,6 +163,10 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["slo_fail_run"] = True
     if args.chaos_spec is not None:
         overrides["chaos_spec"] = args.chaos_spec
+    if args.pop_spec is not None:
+        overrides["pop_spec"] = args.pop_spec
+    if args.pop_seed is not None:
+        overrides["pop_seed"] = args.pop_seed
     if args.chaos_seed is not None:
         overrides["chaos_seed"] = args.chaos_seed
     if args.heartbeat_timeout is not None:
@@ -210,6 +223,16 @@ def main(argv: list[str] | None = None) -> int:
 
     from tpu_rl.runtime import runner
 
+    if args.role == "population":
+        # The controller IS the orchestrator: it runs in this process and
+        # drives its own supervisor (members are the children), so it does
+        # not go through the sup.loop() path below.
+        ctrl = runner.population_role(
+            cfg, machines, max_updates=args.max_updates
+        )
+        ctrl.install_signal_handlers()
+        doc = ctrl.run()
+        return 0 if doc.get("ok") else 1
     if cfg.env_mode == "colocated" and args.role in ("manager", "worker"):
         print(
             f"colocated mode has no {args.role} role: the envs live inside "

@@ -47,33 +47,15 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+# The fingerprinted field list lives in config.py (jax-free) so
+# Config.validate can enforce the population plane's searchable-field rule
+# against it; re-exported here under the historical name.
+from tpu_rl.config import FINGERPRINT_FIELDS as _FINGERPRINT_FIELDS
+
 # Marker filename inside a committed checkpoint dir. Its presence is the
 # commit point; its content is the run-meta JSON. Orbax ignores foreign
 # files in the directory on restore (probed against orbax 0.7.0).
 COMMIT_MARKER = "COMMITTED"
-
-# Config fields that shape the train-state pytree or the meaning of its
-# numbers — the resume compatibility surface. Runtime knobs (ports,
-# supervision, telemetry, chaos, throttles) are deliberately excluded:
-# changing them must never strand a checkpoint.
-_FINGERPRINT_FIELDS = (
-    "env",
-    "algo",
-    "model",
-    "hidden_size",
-    "n_heads",
-    "n_layers",
-    "seq_len",
-    "attention_impl",
-    "obs_shape",
-    "action_space",
-    "is_continuous",
-    "compute_dtype",
-    "need_conv",
-    "height",
-    "width",
-    "is_gray",
-)
 
 
 def resume_fingerprint(cfg) -> str:
@@ -126,6 +108,50 @@ def latest_committed(model_dir: str, algo: str) -> tuple[int, str] | None:
     """(idx, path) of the newest committed checkpoint, or None."""
     found = _ckpt_dirs(os.path.abspath(model_dir), algo)
     return found[-1] if found else None
+
+
+def copy_committed(
+    src_path: str,
+    dst_model_dir: str,
+    algo: str,
+    dst_idx: int,
+    meta_overrides: dict | None = None,
+) -> str:
+    """Cross-member checkpoint copy preserving two-phase commit semantics —
+    the PBT exploit step (``tpu_rl.population``): a loser member adopts the
+    winner's newest COMMITTED tree as ``{dst_model_dir}/{algo}_{dst_idx}``.
+
+    The copy re-enacts the write ordering of :meth:`Checkpointer._write`:
+    the orbax tree files are copied WITHOUT the marker, then the marker —
+    the source's run-meta with ``meta_overrides`` applied (the exploit sets
+    ``idx``/``epoch``/lineage keys) — is placed last via tmp + fsync +
+    ``os.replace``. A crash or SIGKILL at ANY point mid-copy therefore
+    leaves an uncommitted dir that no reader ever sees: the destination
+    member's next resume falls back to its own previous committed
+    checkpoint, and the debris is swept by ``Checkpointer._clean_torn`` at
+    its next init. Pure host-side file I/O — callers (the controller) never
+    need the destination member's train-state structure.
+    """
+    if not is_committed(src_path):
+        raise ValueError(f"source checkpoint {src_path} is not committed")
+    dst_path = os.path.join(
+        os.path.abspath(dst_model_dir), f"{algo}_{dst_idx}"
+    )
+    shutil.rmtree(dst_path, ignore_errors=True)  # stale torn debris only
+    os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+    shutil.copytree(
+        src_path,
+        dst_path,
+        ignore=shutil.ignore_patterns(COMMIT_MARKER, f".{COMMIT_MARKER}.tmp"),
+    )
+    meta = {**read_meta(src_path), **(meta_overrides or {}), "idx": dst_idx}
+    tmp = os.path.join(dst_path, f".{COMMIT_MARKER}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dst_path, COMMIT_MARKER))
+    return dst_path
 
 
 def restore_actor_params(model_dir: str, algo: str):

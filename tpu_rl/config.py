@@ -21,6 +21,34 @@ from typing import Any
 # its specs; kept here so the host-only data plane never imports jax.
 OFF_POLICY_ALGOS = frozenset({"SAC", "SAC-Continuous"})
 
+# Config fields that shape the train-state pytree or the meaning of its
+# numbers — the resume compatibility surface hashed by
+# ``tpu_rl.checkpoint.resume_fingerprint``. Runtime knobs (ports,
+# supervision, telemetry, chaos, throttles) are deliberately excluded:
+# changing them must never strand a checkpoint. Lives here (not in
+# checkpoint.py, which imports jax) so ``Config.validate`` can enforce the
+# population plane's searchable-field rule — a pop-spec may only mutate
+# fields OUTSIDE this set, because an exploit step copies checkpoints
+# across members and a fingerprint-changing mutation would strand them.
+FINGERPRINT_FIELDS = (
+    "env",
+    "algo",
+    "model",
+    "hidden_size",
+    "n_heads",
+    "n_layers",
+    "seq_len",
+    "attention_impl",
+    "obs_shape",
+    "action_space",
+    "is_continuous",
+    "compute_dtype",
+    "need_conv",
+    "height",
+    "width",
+    "is_gray",
+)
+
 
 def is_off_policy(algo: str) -> bool:
     return algo in OFF_POLICY_ALGOS
@@ -469,6 +497,22 @@ class Config:
     # shutdown has any hard-failing rule, the storage child exits nonzero
     # so smokes/CI fail loudly instead of averaging over a breached run.
     slo_fail_run: bool = False
+    # ---- population plane (tpu_rl.population) ----
+    # PBT search-space + schedule grammar, e.g.
+    # "lr:log[1e-4,1e-2] entropy_coef:lin[0,0.05] perturb=1.2,0.8
+    #  interval=200u k=4 quantile=0.25". Whitespace-separated clauses:
+    # sampled dimensions (field:log/lin/choice[...]) plus schedule knobs
+    # (perturb factors, eval interval in member updates 'u' or wall seconds
+    # 's', truncation quantile, population size k, fitness metric). Grammar
+    # and semantics: tpu_rl/population/spec.py. Parse-checked (including
+    # the searchable-field rule: sampled fields must be numeric and
+    # fingerprint-exempt) at config load, like chaos_spec.
+    pop_spec: str | None = None
+    # Base seed for the population plane. Member seeds, initial sampling
+    # and exploit mutations all derive via fold_in(pop_seed, member_idx,
+    # ...), so identical (pop_spec, pop_seed) reproduce identical
+    # populations.
+    pop_seed: int = 0
     # Rollout-lineage sampling: every Nth worker tick ships a 28-byte trace
     # context (wid, seq, trace id, send timestamp) as an optional THIRD wire
     # part; each hop (worker, manager, storage, assembler, learner) records
@@ -494,12 +538,34 @@ class Config:
     def from_dict(cls, raw: dict[str, Any]) -> "Config":
         names = {f.name for f in dataclasses.fields(cls)}
         kwargs = {k: v for k, v in raw.items() if k in names}
+        # JSON has no tuples; re-tuple the tuple-typed fields so a config
+        # that round-trips through to_json/from_json compares equal (==) to
+        # the original — the population controller relies on this when it
+        # respawns members from rewritten config.json files.
+        for k in ("obs_shape", "value_target_clip"):
+            if isinstance(kwargs.get(k), list):
+                kwargs[k] = tuple(kwargs[k])
         cfg = cls(**kwargs)
         cfg.validate()
         return cfg
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
+
+    def to_json(self, path: str | os.PathLike) -> None:
+        """Write this config as a parameters.json-shaped file — the exact
+        shape ``from_json`` loads, completing the round trip. Written
+        crash-atomically (tmp + ``os.replace``) because the population
+        controller rewrites a live member's config.json on exploit: a
+        member respawning mid-rewrite must read either the old or the new
+        config, never a torn one."""
+        path = os.fspath(path)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def validate(self) -> None:
         assert self.seq_len >= 2, "seq_len must be >= 2 (losses bootstrap from t+1)"
@@ -657,6 +723,16 @@ class Config:
             from tpu_rl.obs.slo import parse_slo_spec
 
             parse_slo_spec(self.slo_spec)
+        if self.pop_spec:
+            # Same fail-at-load contract again, plus the searchable-field
+            # rule: a sampled dimension must name a numeric Config field
+            # OUTSIDE FINGERPRINT_FIELDS (mutating a structural field would
+            # strand every checkpoint the exploit step copies). spec.py is
+            # stdlib-only, so this import stays cheap.
+            from tpu_rl.population.spec import PopSpec
+
+            PopSpec.parse(self.pop_spec).check_searchable()
+        assert self.pop_seed >= 0, self.pop_seed
         assert 0 <= self.telemetry_port < 65536, self.telemetry_port
         assert self.telemetry_interval_s > 0, self.telemetry_interval_s
         assert self.telemetry_stale_s > 0, self.telemetry_stale_s
@@ -834,24 +910,19 @@ class MachinesConfig:
         N-replica fleets — a range that lands on the learner/model/stat
         ports or any worker manager port fails HERE, at topology load, not
         as an EADDRINUSE minutes later inside a spawned replica."""
+        # Delegated to the shared allocator (runtime/portplan.py) since the
+        # population plane plans member ports with the same arithmetic;
+        # lazy import because portplan duck-types this topology and must
+        # not be imported back into config at module level.
+        from tpu_rl.runtime.portplan import plan_range, reserved_ports
+
         base = cfg.inference_base_port or self.inference_port
-        ports = [base + i for i in range(cfg.inference_replicas)]
-        reserved = {
-            self.learner_port: "learner_port (rollout/stat fan-in)",
-            self.model_port: "model_port (weight broadcast)",
-        }
-        if cfg.telemetry_port:
-            reserved[cfg.telemetry_port] = "telemetry_port (HTTP exporter)"
-        for w in self.workers:
-            reserved.setdefault(w.port, "worker manager port")
-        for p in ports:
-            if p in reserved:
-                raise ValueError(
-                    f"inference replica port {p} (range [{base}, "
-                    f"{base + cfg.inference_replicas})) collides with "
-                    f"{reserved[p]}"
-                )
-        return ports
+        return plan_range(
+            base,
+            cfg.inference_replicas,
+            reserved_ports(self, cfg),
+            "inference replica",
+        )
 
 
 def default_result_dirs(base: str = "results") -> tuple[str, str]:

@@ -72,16 +72,34 @@ def plan(
     ssh_user: str | None,
     conda_env: str | None,
     workdir: str = "~/tpu_rl_deploy",
+    population: bool = False,
 ) -> list[list[str]]:
     """The full launch plan as a list of argv commands, in execution order:
     rsync to every machine, then learner, then per worker-machine a manager
-    and the workers (reference run.py:54-99)."""
+    and the workers (reference run.py:54-99). With ``population=True`` the
+    learner host runs the PBT controller instead (``tpu_rl.population``) —
+    the controller supervises its K member fleets itself inside private
+    port blocks, so no manager/worker fan-out is launched."""
     cmds: list[list[str]] = []
     hosts = (
         {machines.learner_ip}
         | {w.ip for w in machines.workers}
         | {w.manager_ip for w in machines.workers}  # manager may be a 3rd host
     )
+    if population:
+        cmds.append(rsync_cmd(machines.learner_ip, ssh_user, repo, workdir))
+        cmds.append(
+            _remote(
+                _tmux_wrap(
+                    "tpurl-population",
+                    role_cmd("population", machines_path, params_path,
+                             conda_env=conda_env, workdir=workdir),
+                ),
+                machines.learner_ip,
+                ssh_user,
+            )
+        )
+        return cmds
     for host in sorted(hosts):
         cmds.append(rsync_cmd(host, ssh_user, repo, workdir))
     cmds.append(
@@ -130,6 +148,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--ssh-user")
     p.add_argument("--conda-env")
     p.add_argument("--workdir", default="~/tpu_rl_deploy")
+    p.add_argument("--population", action="store_true",
+                   help="launch the PBT controller on the learner host "
+                   "instead of a single fleet (params must set pop_spec)")
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
 
@@ -137,6 +158,7 @@ def main(argv: list[str] | None = None) -> int:
     cmds = plan(
         machines, args.machines, args.params, args.repo,
         args.ssh_user, args.conda_env, args.workdir,
+        population=args.population,
     )
     for cmd in cmds:
         print("$", " ".join(shlex.quote(c) for c in cmd))

@@ -1,0 +1,37 @@
+"""Population plane: PBT sweep/tournament orchestration over K member runs.
+
+ROADMAP item 5 — the fleet-of-fleets layer. A :class:`PopulationController`
+launches K hyperparameter variants as supervised member processes (the fast
+path: one colocated ``ColocatedLoop`` each, the Podracer many-small-
+experiments shape; or a full nested distributed fleet per member), scrapes
+their existing telemetry exporters for fitness, and runs seeded
+truncation-selection PBT: losers stop, adopt the winner's newest COMMITTED
+checkpoint (two-phase copy — ``checkpoint.copy_committed``) and
+hyperparameters, mutate, and resume at a bumped run epoch. Everything is
+reproducible from ``(pop_spec, pop_seed)``; every event is audited to
+``result_dir/population.jsonl`` and the final leaderboard + lineage tree
+lands crash-atomically in ``population.json``.
+"""
+
+from tpu_rl.population.controller import PopulationController, population_doc
+from tpu_rl.population.spec import (
+    PopSpec,
+    SampleDim,
+    fold_in,
+    member_seed,
+    mutate,
+    sample_member,
+    truncation_select,
+)
+
+__all__ = [
+    "PopSpec",
+    "PopulationController",
+    "SampleDim",
+    "fold_in",
+    "member_seed",
+    "mutate",
+    "population_doc",
+    "sample_member",
+    "truncation_select",
+]

@@ -125,6 +125,29 @@ class ColocatedLoop:
         )
         self.layout = BatchLayout.from_config(cfg)
 
+        # Durability (PR 9 semantics, extended to the fused loop for the
+        # population plane): two-phase commits every model_save_interval,
+        # newest-committed resume with fingerprint refusal, run-epoch chain
+        # in the marker meta. The per-iteration PRNG is fold_in(base, it) —
+        # stateless in it — so resuming needs only the update index: the
+        # continued run replays the exact key stream the unbroken run would
+        # have used.
+        self.ckpt = None
+        self.run_epoch = 0
+        self._start_it = 0
+        self._last_saved = -1
+        self._fingerprint = None
+        if cfg.model_dir:
+            from tpu_rl.checkpoint import Checkpointer, resume_fingerprint
+
+            self.ckpt = Checkpointer(
+                cfg.model_dir,
+                cfg.algo,
+                keep=cfg.ckpt_keep,
+                async_save=cfg.ckpt_async,
+            )
+            self._fingerprint = resume_fingerprint(cfg)
+
         rs, bs = replicated(self.mesh), batch_sharding(self.mesh)
         self._rs, self._bs = rs, bs
         # Every rollout output is batch-leading, so one sharding prefix
@@ -340,7 +363,31 @@ class ColocatedLoop:
         if self._json_exp is not None:
             self._json_exp.maybe_export()
 
+    def _record_resume(self, idx: int) -> None:
+        """Append one resume record to result_dir/learner_resume.jsonl —
+        the same audit file (and shape) the distributed learner writes, so
+        resume-smoke-style assertions work against either mode."""
+        if self.cfg.result_dir is None:
+            return
+        import json
+
+        try:
+            os.makedirs(self.cfg.result_dir, exist_ok=True)
+            path = os.path.join(self.cfg.result_dir, "learner_resume.jsonl")
+            with open(path, "a") as f:
+                f.write(
+                    json.dumps(
+                        {"idx": idx, "epoch": self.run_epoch, "t": time.time()}
+                    )
+                    + "\n"
+                )
+        except OSError:
+            pass  # audit is best-effort; the resume itself already happened
+
     def close(self) -> None:
+        if self.ckpt is not None:
+            self.ckpt.close()
+            self.ckpt = None
         if self._http is not None:
             self._http.close()
         if self._prof is not None:
@@ -377,12 +424,29 @@ class ColocatedLoop:
         k_carry = jax.random.fold_in(self._k_base, 0xC0C0)
         from tpu_rl.parallel.dp import replicate
 
-        state = replicate(self.state, self.mesh)
+        state = self.state
+        if self.ckpt is not None:
+            restored = self.ckpt.restore_run(
+                jax.device_get(state),
+                fingerprint=self._fingerprint,
+                force=cfg.resume_force,
+            )
+            if restored is not None:
+                state, self._start_it, meta = restored
+                self.run_epoch = int(meta.get("epoch", 0)) + 1
+                self._record_resume(self._start_it)
+                if log:
+                    print(
+                        f"[colocated] resumed from committed checkpoint "
+                        f"idx {self._start_it} (run epoch {self.run_epoch})",
+                        flush=True,
+                    )
+        state = replicate(state, self.mesh)
         carry = self.init_carry(k_carry)
         stats = self.init_stats()
         metrics: Any = {}
         log_every = max(1, cfg.loss_log_interval)
-        it = 0
+        it = self._start_it
         last_it, last_ep, last_ret = 0, 0, 0.0
         mean_ret, best_ret = 0.0, float("-inf")
         t_mark = time.perf_counter()
@@ -405,6 +469,18 @@ class ColocatedLoop:
             it += 1
             if self._heartbeat is not None:
                 self._heartbeat.value = time.time()
+            if self.ckpt is not None and it % cfg.model_save_interval == 0:
+                # `state` is the program's fresh output buffers (donation
+                # consumes the inputs), so the save path may snapshot it.
+                self.ckpt.save(
+                    state,
+                    it,
+                    meta={
+                        "epoch": self.run_epoch,
+                        "fingerprint": self._fingerprint,
+                    },
+                )
+                self._last_saved = it
             if it % log_every and it != self.max_updates:
                 continue
             # device_get blocks on iteration `it`, so the wall-clock delta
@@ -444,11 +520,28 @@ class ColocatedLoop:
             t_mark = time.perf_counter()
         host_stats = jax.device_get(stats)
         elapsed = time.perf_counter() - t0
+        if (
+            self.ckpt is not None
+            and it > self._start_it
+            and it != self._last_saved
+        ):
+            # Final commit so a member finishing its budget (or stopped by
+            # the controller for an exploit) leaves its newest state
+            # durable — PBT winners are copied from disk, not from RAM.
+            self.ckpt.save(
+                state,
+                it,
+                meta={
+                    "epoch": self.run_epoch,
+                    "fingerprint": self._fingerprint,
+                },
+            )
         writer.flush()
         writer.close()
         self.close()
         episodes = int(host_stats["episodes"])
         ret_sum = float(host_stats["ret_sum"])
+        new_it = it - self._start_it
         return {
             "updates": it,
             "env_steps": it * n * s,
@@ -459,7 +552,7 @@ class ColocatedLoop:
             # "did it learn" signal (on-policy curves oscillate after peak).
             "mean_return_best_window": best_ret,
             "elapsed_s": elapsed,
-            "transitions_per_s": it * n * s / max(elapsed, 1e-9),
+            "transitions_per_s": new_it * n * s / max(elapsed, 1e-9),
             "scalars": timer.scalars(),
         }
 

@@ -73,6 +73,11 @@ class Child:
     proc: mp.Process
     heartbeat: Any  # mp.Value("d")
     cpu_only: bool
+    # Daemonic children die with the supervisor (the default and the right
+    # answer for leaf roles). Population members running a NESTED fleet
+    # must be non-daemonic — multiprocessing forbids daemonic processes
+    # from having children of their own.
+    daemon: bool = True
     restarts: int = 0
     started_at: float = 0.0
     # Sliding-window restart budget + backoff state (Supervisor.check):
@@ -140,7 +145,12 @@ class Supervisor:
 
     # ----------------------------------------------------------------- spawn
     def spawn(
-        self, name: str, target: Callable, *args, cpu_only: bool = True
+        self,
+        name: str,
+        target: Callable,
+        *args,
+        cpu_only: bool = True,
+        daemon: bool = True,
     ) -> Child:
         from tpu_rl.utils.errlog import role_entry
 
@@ -154,6 +164,7 @@ class Supervisor:
             proc=None,  # type: ignore[arg-type]
             heartbeat=hb,
             cpu_only=cpu_only,
+            daemon=daemon,
         )
         self._start(child)
         self.children.append(child)
@@ -173,7 +184,10 @@ class Supervisor:
             target = functools.partial(target, probe_accelerator=True)
         with _child_env(**env):
             child.proc = self.ctx.Process(
-                target=target, args=child.args, name=child.name, daemon=True
+                target=target,
+                args=child.args,
+                name=child.name,
+                daemon=child.daemon,
             )
             child.heartbeat.value = self.clock()
             child.started_at = self.clock()
@@ -570,3 +584,17 @@ def local_cluster(
     manager_role(cfg, machines, supervisor=sup)
     worker_role(cfg, machines, supervisor=sup, seed=seed)
     return sup
+
+
+def population_role(
+    cfg: Config,
+    machines: MachinesConfig | None = None,
+    max_updates: int | None = None,
+):
+    """Build the PBT controller (``population/controller.py``). Unlike the
+    other roles this returns the controller, not a Supervisor: the
+    controller IS the orchestrator and runs in the calling process, owning
+    its own supervisor whose children are the K ``member-<k>`` runs."""
+    from tpu_rl.population import PopulationController
+
+    return PopulationController(cfg, machines=machines, max_updates=max_updates)
