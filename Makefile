@@ -4,7 +4,8 @@ PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
-	resume-smoke slo-smoke loadgen-smoke heal-smoke pbt-smoke ci
+	resume-smoke slo-smoke loadgen-smoke heal-smoke pbt-smoke \
+	goodput-smoke ci
 
 lint:
 	ruff check .
@@ -126,6 +127,14 @@ heal-smoke:
 pbt-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/pbt_smoke.py
 
+# Goodput-plane smoke: 3-worker cluster with the wall-clock ledger on —
+# every role's bucket ratios sum to 1 within 1% (overcommit <= 1%), all
+# roles show nonzero goodput, gauge:learner-goodput-ratio>0.0 evaluates
+# green on /slo, a SIGSTOP'd worker surfaces as the top straggler on
+# /goodput, and `python -m tpu_rl.obs.top --once` renders a live frame.
+goodput-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/goodput_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
-	loadgen-smoke heal-smoke pbt-smoke
+	loadgen-smoke heal-smoke pbt-smoke goodput-smoke

@@ -372,6 +372,105 @@ def perf_crosscheck(warmup: int = 3, iters: int = 30) -> dict:
     }
 
 
+def goodput_crosscheck(
+    updates: int = 64,
+    feeders: int = 2,
+    batch_size: int = 32,
+    seq_len: int = 5,
+    hidden_size: int = 32,
+    model_port: int = 29894,
+) -> dict:
+    """Goodput ledger vs the execution timer on the SAME live learner run:
+    the ledger's train-step attribution (compute + recompile — the first
+    dispatch carries the jit compile and lands in recompile) must equal the
+    sum of the windowed ``learner-step-time`` spans within ±5%. Both observe
+    identical dispatch boundaries, so disagreement means the ledger dropped
+    or double-counted main-lane time — the same structural guarantee
+    ``perf_crosscheck`` gives the MFU gauges, extended to the goodput plane.
+    ``updates`` must stay under the timer's 100-span window (chain=1, one
+    span per update) so the deque retains every step."""
+    import tempfile
+    import threading
+
+    from tpu_rl.config import Config
+    from tpu_rl.data.layout import BatchLayout
+    from tpu_rl.data.shm_ring import OnPolicyStore, alloc_handles
+    from tpu_rl.runtime.learner_service import LearnerService
+    from tpu_rl.types import BATCH_FIELDS
+
+    assert updates < 100, "timer windows hold 100 spans; keep them all"
+    with tempfile.TemporaryDirectory() as result_dir:
+        # result_dir turns the telemetry plane on (Config.telemetry_enabled);
+        # the stat PUB merely connects, so no listener is needed.
+        cfg = Config.from_dict(
+            dict(
+                algo="IMPALA", batch_size=batch_size, seq_len=seq_len,
+                hidden_size=hidden_size, obs_shape=(4,), action_space=2,
+                learner_chain=1, learner_prefetch=2,
+                loss_log_interval=10**9, result_dir=result_dir,
+            )
+        )
+        layout = BatchLayout.from_config(cfg)
+        handles = alloc_handles(layout, capacity=cfg.batch_size)
+        rng = np.random.default_rng(0)
+        window = {}
+        for f in BATCH_FIELDS:
+            shape = (layout.seq_len, layout.width(f))
+            if f == "act":
+                window[f] = rng.integers(0, 2, size=shape).astype(np.float32)
+            elif f == "is_fir":
+                a = np.zeros(shape, np.float32)
+                a[0] = 1.0
+                window[f] = a
+            elif f == "log_prob":
+                window[f] = np.full(shape, -0.7, np.float32)
+            else:
+                window[f] = rng.standard_normal(shape).astype(np.float32) * 0.1
+
+        stop = threading.Event()
+        put_lock = threading.Lock()
+
+        def feed() -> None:
+            store = OnPolicyStore(handles, layout)
+            while not stop.is_set():
+                with put_lock:
+                    ok = store.put(window)
+                if not ok:
+                    time.sleep(0)
+
+        threads = [
+            threading.Thread(target=feed, daemon=True) for _ in range(feeders)
+        ]
+        for t in threads:
+            t.start()
+        svc = LearnerService(
+            cfg, handles, model_port=model_port, stop_event=stop,
+            max_updates=updates, publish_interval=10**9,
+            stat_port=model_port + 1,
+        )
+        try:
+            svc.run()
+        finally:
+            stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    snap = svc.ledger.snapshot()
+    step_sum = sum(svc.timer.elapsed.get("learner-step-time", ()))
+    ledger_sum = snap["buckets"]["compute"] + snap["buckets"]["recompile"]
+    return {
+        "updates": updates,
+        "step_timer_s": round(step_sum, 4),
+        "ledger_step_s": round(ledger_sum, 4),
+        "agreement": (
+            round(ledger_sum / step_sum, 4) if step_sum > 0 else None
+        ),
+        "goodput": round(snap["goodput"], 4),
+        "ratios_sum": round(sum(snap["ratios"].values()), 4),
+        "overcommit_ratio": round(snap["overcommit_ratio"], 6),
+    }
+
+
 def run_all(out_path: str | None = None) -> dict:
     rows = []
     workloads = WORKLOADS
@@ -412,6 +511,11 @@ def run_all(out_path: str | None = None) -> dict:
         result["perf_plane"] = perf_crosscheck()
     except Exception as e:  # noqa: BLE001
         result["perf_plane"] = {"error": f"{type(e).__name__}: {e}"}
+    try:
+        # Goodput-plane agreement: ledger vs timer on a live learner.
+        result["goodput_plane"] = goodput_crosscheck()
+    except Exception as e:  # noqa: BLE001
+        result["goodput_plane"] = {"error": f"{type(e).__name__}: {e}"}
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
 

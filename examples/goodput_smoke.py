@@ -1,0 +1,272 @@
+"""Goodput-plane smoke: run the smallest real cluster (3 workers) with the
+ledger plane on and a SIGSTOP chaos fault on one worker, then assert the
+PR's live invariants end to end:
+
+1. every role's published ledger is exhaustive — bucket ratios sum to 1
+   with ``overcommit_ratio`` <= 1% (nothing double-counted), and learner /
+   storage / manager / worker all show NONZERO goodput;
+2. ``gauge:learner-goodput-ratio>0.0`` is accepted and evaluated by the
+   SLO engine (``/slo`` green, the rule present with data);
+3. the SIGSTOP'd worker surfaces as the TOP straggler in ``GET /goodput``
+   (report-only: frame rate collapses to 0 against a healthy fleet);
+4. ``python -m tpu_rl.obs.top --once`` renders one dashboard frame against
+   the live fleet and exits 0.
+
+Exits nonzero on any failure — this is the ``make goodput-smoke`` CI gate.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/goodput_smoke.py \
+      [--base-port 30700] [--telemetry-port 30760]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STOPPED_WID = 1  # chaos stops worker-0-1 — wid 1 on the single machine
+GOODPUT_ROLES = ("learner", "storage", "manager", "worker")
+
+
+def _get_json(url: str, timeout: float = 3.0):
+    """GET -> (status, parsed doc); HTTPError bodies (503 /slo) count."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, None
+    except (urllib.error.URLError, ConnectionError, OSError, ValueError):
+        return None, None
+
+
+def _ledger_problems(doc: dict) -> list[str]:
+    """The exhaustiveness invariant over every published breakdown: ratios
+    sum to 1 within 1% and overcommit <= 1%."""
+    problems = []
+    entries = dict(doc.get("roles") or {})
+    storage_snap = doc.get("storage")
+    if storage_snap is not None:
+        entries["storage/self"] = {
+            "goodput": storage_snap.get("goodput"),
+            "ratios": storage_snap.get("ratios") or {},
+            "overcommit_ratio": storage_snap.get("overcommit_ratio"),
+        }
+    for key, e in entries.items():
+        total = sum((e.get("ratios") or {}).values())
+        if not 0.99 <= total <= 1.01:
+            problems.append(f"{key}: bucket ratios sum {total:.4f} not ~1")
+        over = e.get("overcommit_ratio")
+        if over is not None and over > 0.01:
+            problems.append(f"{key}: overcommit_ratio {over:.4f} > 1%")
+    return problems
+
+
+def _coverage_gaps(doc: dict) -> list[str]:
+    """Nonzero goodput on every role the smoke deploys."""
+    gaps = []
+    entries = doc.get("roles") or {}
+    storage_snap = doc.get("storage") or {}
+    for role in GOODPUT_ROLES:
+        if role == "storage":
+            vals = [storage_snap.get("goodput") or 0.0]
+        else:
+            vals = [
+                e.get("goodput") or 0.0
+                for key, e in entries.items()
+                if key.startswith(role + "/")
+            ]
+        if not vals or max(vals) <= 0.0:
+            gaps.append(f"{role}: no source with goodput > 0")
+    return gaps
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base-port", type=int, default=30700)
+    p.add_argument("--telemetry-port", type=int, default=30760)
+    p.add_argument("--timeout", type=float, default=180.0)
+    args = p.parse_args()
+
+    from tests.conftest import small_config
+    from tpu_rl.config import MachinesConfig, WorkerMachine
+    from tpu_rl.runtime.runner import local_cluster
+
+    run_dir = tempfile.mkdtemp(prefix="goodput_smoke_")
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        worker_step_sleep=0.0,
+        learner_device="cpu",
+        rollout_lag_sec=30.0,
+        time_horizon=100,
+        loss_log_interval=1000,
+        result_dir=run_dir,
+        telemetry_port=args.telemetry_port,
+        telemetry_interval_s=0.5,
+        telemetry_stale_s=120.0,
+        slo_spec="gauge:learner-goodput-ratio>0.0",
+        # SIGSTOP one of three workers shortly after launch: silent to the
+        # heartbeat plane, so a huge timeout keeps the supervisor from
+        # healing it — the straggler report, not quarantine, must find it.
+        chaos_spec=f"stop:worker-0-{STOPPED_WID}@t+2s",
+        heartbeat_timeout_s=600.0,
+    )
+    machines = MachinesConfig(
+        learner_ip="127.0.0.1",
+        learner_port=args.base_port,
+        workers=[WorkerMachine(
+            num_p=3, manager_ip="127.0.0.1", ip="127.0.0.1",
+            port=args.base_port + 5,
+        )],
+    )
+    base = f"http://127.0.0.1:{args.telemetry_port}"
+    failures: list[str] = []
+    print(f"[goodput-smoke] cluster up; run_dir={run_dir}", flush=True)
+    # Generous budget: the smoke stops the fleet itself once every live
+    # assertion has been observed (or the deadline passes).
+    sup = local_cluster(cfg, machines, max_updates=2000)
+    last: dict = {}
+    try:
+        deadline = time.time() + args.timeout
+        pending = {"ledger", "coverage", "slo", "straggler"}
+        fleet_warm = False
+        while time.time() < deadline and pending:
+            time.sleep(1.0)
+            if fleet_warm and sup.chaos is not None:
+                # The smoke is the supervision loop here: chaos one-shots
+                # fire from this poll (Supervisor.loop is not running). The
+                # first poll resolves the plan's t+2s, so holding it until
+                # the fleet is warm guarantees the stopped worker has
+                # frames on record to collapse from.
+                for action, name in sup.chaos.poll(sup.children):
+                    print(f"[goodput-smoke] chaos {action} -> {name}",
+                          flush=True)
+            status, doc = _get_json(base + "/goodput")
+            if status != 200 or doc is None:
+                continue
+            last = doc
+            if not fleet_warm:
+                rates = doc.get("rates") or {}
+                if len(rates) >= 3 and all(v > 0 for v in rates.values()):
+                    fleet_warm = True
+                    print(
+                        f"[goodput-smoke] fleet warm (3 wids producing); "
+                        f"arming chaos stop of wid {STOPPED_WID}",
+                        flush=True,
+                    )
+            if "ledger" in pending and not _ledger_problems(doc):
+                pending.discard("ledger")
+                print("[goodput-smoke] ledger sums ok (overcommit <= 1%)",
+                      flush=True)
+            if "coverage" in pending and not _coverage_gaps(doc):
+                pending.discard("coverage")
+                print("[goodput-smoke] nonzero goodput on every role",
+                      flush=True)
+            if "straggler" in pending and sup.chaos is not None and (
+                sup.chaos.n_stops > 0
+            ):
+                # Only a truly stopped worker has a COLLAPSED windowed frame
+                # rate — a startup staleness transient cannot fake this.
+                top = doc.get("stragglers") or []
+                rate = (top[0].get("signals") or {}).get(
+                    "frame-rate"
+                ) if top else None
+                if (
+                    top
+                    and top[0].get("wid") == STOPPED_WID
+                    and top[0].get("score", 0.0) > 2.0
+                    and rate is not None
+                    and rate < 1.0
+                ):
+                    pending.discard("straggler")
+                    print(
+                        f"[goodput-smoke] SIGSTOP'd wid {STOPPED_WID} is the "
+                        f"top straggler (score {top[0]['score']}, "
+                        f"rate {rate}/s)",
+                        flush=True,
+                    )
+            if "slo" in pending:
+                s_status, s_doc = _get_json(base + "/slo")
+                if s_status == 200 and s_doc and s_doc.get("ok") is True:
+                    rules = s_doc.get("rules") or []
+                    hit = [
+                        r for r in rules
+                        if "learner-goodput-ratio" in str(
+                            r.get("rule") or r.get("spec") or ""
+                        )
+                    ]
+                    if hit and hit[0].get("ok") is True:
+                        pending.discard("slo")
+                        print(
+                            "[goodput-smoke] SLO accepts "
+                            "gauge:learner-goodput-ratio>0.0 (green)",
+                            flush=True,
+                        )
+        for what in sorted(pending):
+            detail = ""
+            if what == "ledger":
+                detail = f": {_ledger_problems(last)}" if last else ""
+            elif what == "coverage":
+                detail = f": {_coverage_gaps(last)}" if last else ""
+            elif what == "straggler":
+                detail = f": top={last.get('stragglers')}" if last else ""
+            failures.append(f"never observed live invariant '{what}'{detail}")
+
+        # Dashboard renders one frame against the LIVE fleet, no tty.
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_rl.obs.top",
+                "--once", "--url", base + "/metrics",
+            ],
+            capture_output=True, text=True, timeout=60,
+            env={
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                ),
+            },
+        )
+        if proc.returncode != 0 or "GOODPUT" not in proc.stdout:
+            failures.append(
+                f"top --once failed: rc={proc.returncode} "
+                f"stdout={proc.stdout[:400]!r} stderr={proc.stderr[:400]!r}"
+            )
+        else:
+            print("[goodput-smoke] dashboard frame:", flush=True)
+            print(proc.stdout, flush=True)
+    finally:
+        sup.stop()
+
+    # The offline twin: storage appends ledger snapshots on the exporter
+    # cadence; at least one line must have landed and parse back.
+    try:
+        with open(os.path.join(run_dir, "goodput.jsonl")) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        if not lines or "storage" not in lines[-1]:
+            failures.append(f"goodput.jsonl malformed: {lines[-1:]}")
+    except (OSError, ValueError) as e:
+        failures.append(f"goodput.jsonl missing/invalid: {e}")
+
+    if failures:
+        for f in failures:
+            print(f"[goodput-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[goodput-smoke] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

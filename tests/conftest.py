@@ -31,6 +31,11 @@ def pytest_configure(config):
         "timeout(seconds): fail the test if it exceeds the deadline "
         "(SIGALRM-based; pytest-timeout is not in this image)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); exercised by "
+        "make ci's smoke targets or an explicit -m slow invocation",
+    )
 
 
 @pytest.hookimpl(wrapper=True)

@@ -65,6 +65,11 @@ MANIFEST: dict[str, dict[str, str]] = {
     "tpu_rl/data/assembler.py": {
         "RolloutAssembler.push_tick": STRICT,
     },
+    "tpu_rl/obs/goodput.py": {
+        # The ledger tick rides every role's main loop (storage: per
+        # recv/ingest pass): one float add, no allocation.
+        "GoodputLedger.add": STRICT,
+    },
     "tpu_rl/runtime/worker.py": {
         "Worker.run": FMT,
     },

@@ -107,10 +107,22 @@ class InferenceReplica(InferenceService):
         store_carry = self.family.store_carry
         pending = []
         pending_rows = 0
+        ledger = self.ledger
+        if ledger is not None:
+            from tpu_rl.obs.goodput import COMPUTE, IDLE, QUEUE_WAIT, WIRE
 
         while not self._stop.is_set():
             # Block only when idle; with work queued, just sweep the socket.
+            t_recv = time.perf_counter()
             got = router.recv(timeout_ms=0 if pending else 20)
+            if ledger is not None:
+                span = time.perf_counter() - t_recv
+                if pending:
+                    ledger.add(QUEUE_WAIT, span)
+                elif got is not None:
+                    ledger.add(WIRE, span)
+                else:
+                    ledger.add(IDLE, span)
             if got is not None:
                 req = self._ingest(*got)
                 if req is not None:
@@ -142,9 +154,12 @@ class InferenceReplica(InferenceService):
             else:
                 self.n_flush_continuous += 1
             key, sub = jax.random.split(key)
+            t_fl = time.perf_counter()
             self._flush(
                 router, step, chunk, rows, pad_rows, sub, store_carry, jnp
             )
+            if ledger is not None:
+                ledger.add(COMPUTE, time.perf_counter() - t_fl)
 
 
 def replica_main(
@@ -228,6 +243,8 @@ def replica_main(
                     registry.counter("inference-xla-recompiles").set_total(
                         svc.perf.recompiles
                     )
+                if svc.ledger is not None:
+                    svc.ledger.publish(registry)
                 emitter.maybe_emit()
             if heartbeat is not None:
                 heartbeat.value = time.time()

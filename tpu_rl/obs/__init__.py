@@ -13,6 +13,7 @@ from tpu_rl.obs.aggregator import (
     TelemetryAggregator,
     maybe_aggregator,
 )
+from tpu_rl.obs.audit import append_jsonl, append_resume
 from tpu_rl.obs.clocksync import ClockEstimate, ClockSync
 from tpu_rl.obs.exporters import (
     JsonExporter,
@@ -22,6 +23,14 @@ from tpu_rl.obs.exporters import (
     render_prometheus,
 )
 from tpu_rl.obs.flightrec import FlightRecorder
+from tpu_rl.obs.goodput import (
+    BUCKETS,
+    STRAGGLER_GAUGE,
+    GoodputLedger,
+    maybe_ledger,
+    robust_z,
+    straggler_report,
+)
 from tpu_rl.obs.merge import merge_result_dir, merge_traces
 from tpu_rl.obs.perf import (
     PEAK_FLOPS,
@@ -44,10 +53,12 @@ from tpu_rl.obs.slo import SloEngine, SloRule, maybe_slo_engine, parse_slo_spec
 from tpu_rl.obs.trace import TraceRecorder
 
 __all__ = [
+    "BUCKETS",
     "ClockEstimate",
     "ClockSync",
     "DEFAULT_STALE_AFTER_S",
     "FlightRecorder",
+    "GoodputLedger",
     "HIST_BUCKETS",
     "JsonExporter",
     "LEARNER_VERSION_GAUGE",
@@ -57,17 +68,21 @@ __all__ = [
     "PeriodicSnapshot",
     "ProfilerCapture",
     "STALENESS_HIST",
+    "STRAGGLER_GAUGE",
     "SloEngine",
     "SloRule",
     "TelemetryAggregator",
     "TelemetryHTTPServer",
     "TensorboardExporter",
     "TraceRecorder",
+    "append_jsonl",
+    "append_resume",
     "device_memory_bytes",
     "device_peak_flops",
     "diff_snapshots",
     "hist_quantile",
     "maybe_aggregator",
+    "maybe_ledger",
     "maybe_perf_tracker",
     "maybe_slo_engine",
     "merge_result_dir",
@@ -77,4 +92,6 @@ __all__ = [
     "process_self_stats",
     "render_healthz",
     "render_prometheus",
+    "robust_z",
+    "straggler_report",
 ]

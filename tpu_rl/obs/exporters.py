@@ -143,7 +143,8 @@ class TelemetryHTTPServer:
     ``/healthz`` (JSON liveness) and — when the owner wires the matching
     callable — ``/tracez`` (the role's live span ring + clock estimates),
     ``/slo`` (last SLO verdict: 200 while every rule holds, 503 on any hard
-    failure, so probes can alert off the status line alone) and ``/prof?ms=N``
+    failure, so probes can alert off the status line alone), ``/goodput``
+    (wall-clock attribution breakdown + straggler top-k) and ``/prof?ms=N``
     (bounded on-demand ``jax.profiler`` capture; an overlapping request is
     refused with 409). Daemonized: it must never hold the storage process
     open at shutdown, and :meth:`close` is idempotent and bounded so cluster
@@ -158,11 +159,13 @@ class TelemetryHTTPServer:
         tracez=None,
         slo=None,
         prof=None,
+        goodput=None,
     ):
         self.agg = agg
         self.tracez = tracez  # callable -> JSON-able dict, or None
         self.slo = slo  # callable -> SLO report dict, or None
         self.prof = prof  # callable (ms|None) -> (started, path|reason)
+        self.goodput = goodput  # callable -> goodput/straggler doc, or None
 
         outer = self
 
@@ -189,6 +192,13 @@ class TelemetryHTTPServer:
                     else:
                         payload = outer.slo()
                         status = 200 if payload.get("ok", True) else 503
+                    body = (json.dumps(payload, indent=1) + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/goodput":
+                    if outer.goodput is None:
+                        payload, status = {"error": "goodput ledger not wired"}, 404
+                    else:
+                        payload, status = outer.goodput(), 200
                     body = (json.dumps(payload, indent=1) + "\n").encode()
                     ctype = "application/json"
                 elif path == "/prof":

@@ -1,0 +1,263 @@
+"""Zero-dependency live fleet dashboard: ``python -m tpu_rl.obs.top``.
+
+Polls the storage (or colocated) telemetry HTTP server — ``/metrics``
+(Prometheus text), ``/goodput`` (ledger breakdown + straggler top-k) and
+``/slo`` (verdicts) — and renders a terminal view on stdlib curses:
+per-role goodput bars, bucket breakdowns, throughput/MFU, the straggler
+list, and SLO verdicts. Nothing beyond the standard library; point it at
+any fleet with the plane on::
+
+    python -m tpu_rl.obs.top --url http://learner-host:9090/metrics
+
+``--once`` renders a single frame to stdout without curses (no tty
+needed) — the shape ``make goodput-smoke`` and CI drive. ``q`` quits the
+live view. The frame builder is a pure function over the fetched
+documents (``build_frame``), so the render is golden-testable with a
+mocked terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import urllib.error
+import urllib.request
+
+from tpu_rl.obs.goodput import BUCKETS
+
+DEFAULT_URL = "http://127.0.0.1:9090/metrics"
+_SAMPLE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+# ------------------------------------------------------------------ fetch
+def fetch(url: str, timeout: float = 2.0):
+    """GET → (status, body str). An HTTPError with a body (the 503 /slo
+    failing-verdict case) is a real answer, not a transport failure."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except OSError as e:
+        return None, str(e)
+
+
+def fetch_json(url: str, timeout: float = 2.0):
+    status, body = fetch(url, timeout)
+    if status is None:
+        return None
+    try:
+        return json.loads(body)
+    except ValueError:
+        return None
+
+
+# ------------------------------------------------------------------ parse
+def parse_prometheus(text: str) -> list:
+    """Exposition text → [(name, labels dict, value)] (comments skipped)."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        m = _SAMPLE.match(head)
+        if m is None:
+            continue
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        labels = dict(_LABEL.findall(m.group(3) or ""))
+        out.append((m.group(1), labels, value))
+    return out
+
+
+def _source_key(role: str, labels: dict) -> str:
+    wid = labels.get("wid")
+    return f"{role} wid={wid}" if wid is not None else role
+
+
+def goodput_rows(samples: list) -> dict:
+    """Per-source goodput view from the ``*_goodput_ratio`` /
+    ``*_time_*_ratio`` gauge families → {display key: {goodput, buckets}}."""
+    rows: dict = {}
+    bucket_names = {b.replace("-", "_"): b for b in BUCKETS}
+    for name, labels, value in samples:
+        if name.endswith("_goodput_ratio"):
+            role = name[: -len("_goodput_ratio")]
+            key = _source_key(role, labels)
+            rows.setdefault(key, {"goodput": 0.0, "buckets": {}})
+            rows[key]["goodput"] = value
+        elif name.endswith("_ratio") and "_time_" in name:
+            role, _, rest = name.partition("_time_")
+            bucket = bucket_names.get(rest[: -len("_ratio")])
+            if bucket is None:
+                continue
+            key = _source_key(role, labels)
+            rows.setdefault(key, {"goodput": 0.0, "buckets": {}})
+            rows[key]["buckets"][bucket] = value
+    return rows
+
+
+def _scalar(samples: list, name: str):
+    vals = [v for n, _l, v in samples if n == name]
+    return max(vals) if vals else None
+
+
+def bar(frac: float, width: int = 20) -> str:
+    frac = min(1.0, max(0.0, frac))
+    filled = round(frac * width)
+    return "#" * filled + "-" * (width - filled)
+
+
+# ------------------------------------------------------------------ frame
+def build_frame(
+    samples: list,
+    goodput_doc: dict | None,
+    slo_doc: dict | None,
+    url: str = DEFAULT_URL,
+    width: int = 100,
+) -> list:
+    """The whole dashboard as a list of text lines (pure; golden-tested)."""
+    lines = [f"tpu_rl top — {url}  (q quits)", ""]
+    rows = goodput_rows(samples)
+    lines.append("GOODPUT (compute share of wall time, per role)")
+    if not rows:
+        lines.append("  no goodput gauges yet (ledger warming up?)")
+    for key in sorted(rows):
+        row = rows[key]
+        g = row["goodput"]
+        lines.append(f"  {key:<16} [{bar(g)}] {g * 100:5.1f}%")
+        top = sorted(
+            row["buckets"].items(), key=lambda kv: -kv[1]
+        )[:4]
+        detail = "  ".join(f"{b} {v * 100:.0f}%" for b, v in top if v > 0)
+        if detail:
+            lines.append(f"  {'':<16} {detail}")
+    lines.append("")
+
+    hot = []
+    for label, metric, fmt in (
+        ("learner tps", "learner_throughput", "{:,.0f}"),
+        ("colocated tps", "colocated_env_steps_per_s", "{:,.0f}"),
+        ("mfu", "learner_mfu", "{:.2%}"),
+        ("colocated mfu", "colocated_mfu", "{:.2%}"),
+        ("recompiles", "learner_xla_recompiles", "{:.0f}"),
+    ):
+        v = _scalar(samples, metric)
+        if v is not None:
+            hot.append(f"{label} {fmt.format(v)}")
+    if hot:
+        lines.append("THROUGHPUT  " + "   ".join(hot))
+        lines.append("")
+
+    lines.append("STRAGGLERS (robust z vs fleet median; report-only)")
+    stragglers = (goodput_doc or {}).get("stragglers") or []
+    if not stragglers:
+        lines.append("  none")
+    for e in stragglers:
+        sig = e.get("signals", {})
+        rate = sig.get("frame-rate")
+        stale = sig.get("staleness")
+        rtt = sig.get("rtt")
+        lines.append(
+            f"  wid {e.get('wid')}: score {e.get('score', 0):.1f}"
+            f"  rate {rate if rate is not None else '—'}/s"
+            f"  staleness {stale if stale is not None else '—'}"
+            f"  rtt {rtt if rtt is not None else '—'}"
+        )
+    lines.append("")
+
+    if slo_doc is not None:
+        ok = slo_doc.get("ok")
+        verdict = "PASS" if ok else ("no data" if ok is None else "FAIL")
+        lines.append(f"SLO  {verdict}")
+        for rule in slo_doc.get("rules", []):
+            if not isinstance(rule, dict):
+                lines.append(f"  {rule}")
+                continue
+            spec = rule.get("rule") or rule.get("spec") or "?"
+            r_ok = rule.get("ok")
+            mark = "ok " if r_ok else ("?? " if r_ok is None else "FAIL")
+            val = rule.get("value")
+            tail = f"  (value {val})" if val is not None else ""
+            lines.append(f"  [{mark}] {spec}{tail}")
+    else:
+        lines.append("SLO  (no /slo endpoint — no slo_spec configured)")
+    return [ln[:width] for ln in lines]
+
+
+def collect(url: str, timeout: float = 2.0):
+    """Fetch all three endpoints once → (samples, goodput, slo, ok)."""
+    base = url.rsplit("/", 1)[0] if url.endswith("/metrics") else url
+    status, body = fetch(url, timeout)
+    ok = status == 200
+    samples = parse_prometheus(body) if ok else []
+    goodput_doc = fetch_json(base + "/goodput", timeout)
+    slo_doc = fetch_json(base + "/slo", timeout)
+    return samples, goodput_doc, slo_doc, ok
+
+
+# ----------------------------------------------------------------- curses
+def draw(stdscr, lines: list) -> None:
+    import curses
+
+    stdscr.erase()
+    h, w = stdscr.getmaxyx()
+    for y, line in enumerate(lines[: max(0, h - 1)]):
+        try:
+            stdscr.addnstr(y, 0, line, max(1, w - 1))
+        except curses.error:
+            pass  # terminal shrank mid-draw: clip, don't crash
+    stdscr.refresh()
+
+
+def _loop(stdscr, args) -> int:
+    import curses
+
+    try:
+        curses.curs_set(0)
+    except curses.error:
+        pass
+    stdscr.timeout(int(args.interval * 1000))
+    while True:
+        samples, goodput_doc, slo_doc, ok = collect(args.url, args.timeout)
+        lines = build_frame(samples, goodput_doc, slo_doc, url=args.url)
+        if not ok:
+            lines.insert(1, f"  !! /metrics unreachable at {args.url}")
+        draw(stdscr, lines)
+        ch = stdscr.getch()
+        if ch in (ord("q"), ord("Q")):
+            return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_rl.obs.top",
+        description="live fleet dashboard over /metrics + /goodput + /slo",
+    )
+    ap.add_argument("--url", default=DEFAULT_URL, help="metrics endpoint")
+    ap.add_argument("--interval", type=float, default=2.0, help="poll seconds")
+    ap.add_argument("--timeout", type=float, default=2.0, help="fetch timeout")
+    ap.add_argument(
+        "--once", action="store_true",
+        help="render one frame to stdout (no curses, no tty) and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.once:
+        samples, goodput_doc, slo_doc, ok = collect(args.url, args.timeout)
+        frame = build_frame(samples, goodput_doc, slo_doc, url=args.url)
+        print("\n".join(frame))
+        return 0 if ok else 1
+
+    import curses
+
+    return curses.wrapper(_loop, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

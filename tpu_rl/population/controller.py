@@ -189,7 +189,6 @@ class PopulationController:
             m.lineage.append({"ev": "init", "values": dict(values)})
             self.members.append(m)
 
-        self._events_path = os.path.join(cfg.result_dir, "population.jsonl")
         self.aggregator = None
         self._http = None
         self._json_exp = None
@@ -252,13 +251,12 @@ class PopulationController:
 
     # ----------------------------------------------------------------- audit
     def _event(self, ev: dict) -> None:
+        from tpu_rl.obs.audit import append_jsonl
+
+        # Stamp before appending so the printed/forwarded event carries the
+        # same `t` the audit line does (append_jsonl keeps an existing `t`).
         ev = {**ev, "t": time.time()}
-        try:
-            os.makedirs(self.base.result_dir, exist_ok=True)
-            with open(self._events_path, "a") as f:
-                f.write(json.dumps(ev) + "\n")
-        except OSError:
-            pass  # audit is best-effort; the action itself already happened
+        append_jsonl(self.base.result_dir, "population.jsonl", ev)
         if self.log:
             print(f"[population] {json.dumps(ev)}", flush=True)
         if self.on_event is not None:

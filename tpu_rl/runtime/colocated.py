@@ -173,6 +173,11 @@ class ColocatedLoop:
         self._perf = None
         self._prof = None
         self._slo = None
+        # Goodput ledger for the fused loop (tpu_rl.obs.goodput). The whole
+        # deployment is one process, so one ledger covers it: dispatch +
+        # blocking device_get land in compute, checkpoint saves in ckpt,
+        # everything else (telemetry, logging) spills into overhead.
+        self.ledger = None
         self._setup_telemetry()
 
     # ------------------------------------------------------------ device init
@@ -281,6 +286,7 @@ class ColocatedLoop:
         if not cfg.telemetry_enabled:
             return
         from tpu_rl.obs import (
+            GoodputLedger,
             JsonExporter,
             MetricsRegistry,
             PerfTracker,
@@ -294,6 +300,7 @@ class ColocatedLoop:
             registry=MetricsRegistry(role="colocated"),
             stale_after_s=cfg.telemetry_stale_s,
         )
+        self.ledger = GoodputLedger("colocated")
         self._perf = PerfTracker()
         self._slo = maybe_slo_engine(cfg)
         if cfg.result_dir is not None:
@@ -306,6 +313,7 @@ class ColocatedLoop:
                 prof=(
                     self._prof.capture_async if self._prof is not None else None
                 ),
+                goodput=self._goodput_payload,
             )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
@@ -358,31 +366,40 @@ class ColocatedLoop:
             rss, n_fds = process_self_stats()
             reg.gauge("colocated-rss-bytes").set(rss)
             reg.gauge("colocated-open-fds").set(float(n_fds))
+        if self.ledger is not None:
+            self.ledger.publish(reg)
         if self._slo is not None:
             self._slo.evaluate(self.aggregator)
-        if self._json_exp is not None:
-            self._json_exp.maybe_export()
+        if self._json_exp is not None and self._json_exp.maybe_export():
+            if self.ledger is not None:
+                # Ledger audit trail on the exporter's cadence — the offline
+                # twin of GET /goodput, same file name as storage writes.
+                from tpu_rl.obs.audit import append_jsonl
+
+                append_jsonl(
+                    self.cfg.result_dir, "goodput.jsonl",
+                    self._goodput_payload(),
+                )
+
+    def _goodput_payload(self) -> dict:
+        """The GET /goodput document for the single-process deployment: just
+        this loop's ledger snapshot (no fleet, so no stragglers)."""
+        return {
+            "colocated": (
+                self.ledger.snapshot() if self.ledger is not None else None
+            ),
+            "roles": {},
+            "stragglers": [],
+        }
 
     def _record_resume(self, idx: int) -> None:
         """Append one resume record to result_dir/learner_resume.jsonl —
-        the same audit file (and shape) the distributed learner writes, so
-        resume-smoke-style assertions work against either mode."""
-        if self.cfg.result_dir is None:
-            return
-        import json
+        the same audit file (and shape) the distributed learner writes
+        (pinned by test), so resume-smoke-style assertions work against
+        either mode."""
+        from tpu_rl.obs.audit import append_resume
 
-        try:
-            os.makedirs(self.cfg.result_dir, exist_ok=True)
-            path = os.path.join(self.cfg.result_dir, "learner_resume.jsonl")
-            with open(path, "a") as f:
-                f.write(
-                    json.dumps(
-                        {"idx": idx, "epoch": self.run_epoch, "t": time.time()}
-                    )
-                    + "\n"
-                )
-        except OSError:
-            pass  # audit is best-effort; the resume itself already happened
+        append_resume(self.cfg.result_dir, idx, self.run_epoch)
 
     def close(self) -> None:
         if self.ckpt is not None:
@@ -444,6 +461,9 @@ class ColocatedLoop:
         state = replicate(state, self.mesh)
         carry = self.init_carry(k_carry)
         stats = self.init_stats()
+        ledger = self.ledger
+        if ledger is not None:
+            from tpu_rl.obs.goodput import CKPT, COMPUTE
         metrics: Any = {}
         log_every = max(1, cfg.loss_log_interval)
         it = self._start_it
@@ -463,15 +483,19 @@ class ColocatedLoop:
                 self._perf.capture(
                     self.program, state, carry, stats, k_roll, k_train
                 )
+            t_disp = time.perf_counter()
             state, carry, stats, metrics = self.program(
                 state, carry, stats, k_roll, k_train
             )
+            if ledger is not None:
+                ledger.add(COMPUTE, time.perf_counter() - t_disp)
             it += 1
             if self._heartbeat is not None:
                 self._heartbeat.value = time.time()
             if self.ckpt is not None and it % cfg.model_save_interval == 0:
                 # `state` is the program's fresh output buffers (donation
                 # consumes the inputs), so the save path may snapshot it.
+                t_ck = time.perf_counter()
                 self.ckpt.save(
                     state,
                     it,
@@ -480,15 +504,22 @@ class ColocatedLoop:
                         "fingerprint": self._fingerprint,
                     },
                 )
+                if ledger is not None:
+                    ledger.add(CKPT, time.perf_counter() - t_ck)
                 self._last_saved = it
             if it % log_every and it != self.max_updates:
                 continue
             # device_get blocks on iteration `it`, so the wall-clock delta
-            # below covers real device work (dispatch is async in between).
+            # below covers real device work (dispatch is async in between) —
+            # the block lands in the ledger's compute bucket for the same
+            # reason.
+            t_get = time.perf_counter()
             host_stats = jax.device_get(stats)
             host_metrics = {
                 k: float(v) for k, v in jax.device_get(metrics).items()
             }
+            if ledger is not None:
+                ledger.add(COMPUTE, time.perf_counter() - t_get)
             now = time.perf_counter()
             iters = it - last_it
             chunk_s = (now - t_mark) / max(1, iters)
@@ -528,6 +559,8 @@ class ColocatedLoop:
             # Final commit so a member finishing its budget (or stopped by
             # the controller for an exploit) leaves its newest state
             # durable — PBT winners are copied from disk, not from RAM.
+            if ledger is not None:
+                t_ck = time.perf_counter()
             self.ckpt.save(
                 state,
                 it,
@@ -536,6 +569,8 @@ class ColocatedLoop:
                     "fingerprint": self._fingerprint,
                 },
             )
+            if ledger is not None:
+                ledger.add(CKPT, time.perf_counter() - t_ck)
         writer.flush()
         writer.close()
         self.close()

@@ -117,6 +117,12 @@ class InferenceService:
         # per flushed batch + recompile watch. Built by the serve thread iff
         # telemetry is on; the learner's _emit_telemetry reads it.
         self.perf = None
+        # Goodput ledger for the SERVE thread (tpu_rl.obs.goodput), built in
+        # _warm iff telemetry is on. Its own thread-lane: inference wait /
+        # flush time must not double into the owning learner's ledger.
+        # Published by whoever owns the registry (learner _emit_telemetry or
+        # fleet.replica_main).
+        self.ledger = None
         self._jnp = None  # bound by the serve thread (deferred jax import)
         # Service-level fault injection (tpu_rl.chaos): stall:inference
         # sleeps before a batch flush, refuse:inference swallows replies so
@@ -228,9 +234,11 @@ class InferenceService:
         with self._lock:
             params = self._params
         if getattr(self.cfg, "telemetry_enabled", False):
+            from tpu_rl.obs.goodput import GoodputLedger
             from tpu_rl.obs.perf import PerfTracker
 
             self.perf = PerfTracker()
+            self.ledger = GoodputLedger("inference")
             # One-time cost analysis at the padded warmup shape — the
             # only shape the service ever dispatches, so a later cache
             # miss is a real drift signal (inference-xla-recompiles).
@@ -252,6 +260,9 @@ class InferenceService:
         pending: list[_Pending] = []
         pending_rows = 0
         flush_s = cfg.inference_flush_us / 1e6
+        ledger = self.ledger
+        if ledger is not None:
+            from tpu_rl.obs.goodput import COMPUTE, IDLE, QUEUE_WAIT, WIRE
 
         while not self._stop.is_set():
             # Bounded poll: until the flush deadline when requests are
@@ -261,7 +272,19 @@ class InferenceService:
                 timeout_ms = max(0, int(budget * 1e3))
             else:
                 timeout_ms = 20
+            t_recv = time.perf_counter()
             got = router.recv(timeout_ms=timeout_ms)
+            if ledger is not None:
+                # Holding a partial batch for the deadline is queue-wait; a
+                # bare poll that delivered a request is wire; a bare timeout
+                # is idle.
+                if pending:
+                    recv_bucket = QUEUE_WAIT
+                elif got is not None:
+                    recv_bucket = WIRE
+                else:
+                    recv_bucket = IDLE
+                ledger.add(recv_bucket, time.perf_counter() - t_recv)
             if got is not None:
                 req = self._ingest(*got)
                 if req is not None:
@@ -293,10 +316,13 @@ class InferenceService:
                     rows += req.obs.shape[0]
                 pending_rows -= rows
                 key, sub = jax.random.split(key)
+                t_fl = time.perf_counter()
                 self._flush(
                     router, step, chunk, rows, pad_rows, sub,
                     store_carry, jnp,
                 )
+                if ledger is not None:
+                    ledger.add(COMPUTE, time.perf_counter() - t_fl)
                 if rows < cfg.inference_batch:
                     break  # partial tail came from the deadline, done
 

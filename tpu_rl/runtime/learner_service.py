@@ -381,11 +381,31 @@ class LearnerService:
         telem_reg = telem_pub = None
         telem_last = float("-inf")
         self._perf = None
+        ledger = self.ledger = None
         if cfg.telemetry_enabled and self.stat_port is not None:
             from tpu_rl.obs import MetricsRegistry
+            from tpu_rl.obs.goodput import (
+                CKPT,
+                COMPUTE,
+                H2D,
+                IDLE,
+                QUEUE_WAIT,
+                RECOMPILE,
+                ROLLBACK,
+                WIRE,
+                GoodputLedger,
+            )
             from tpu_rl.obs.perf import PerfTracker
 
             telem_reg = MetricsRegistry(role="learner")
+            # Goodput ledger (tpu_rl.obs.goodput): exhaustive wall-clock
+            # attribution for THIS thread only — feeder / async-ckpt-writer /
+            # async-publisher lanes overlap the device step and would
+            # double-count. With prefetch the pop wait is residual feed
+            # latency (queue-wait); the synchronous feed does the shm copy +
+            # H2D inside get(), so the same span is h2d there.
+            ledger = self.ledger = GoodputLedger("learner")
+            wait_bucket = QUEUE_WAIT if cfg.learner_prefetch > 0 else H2D
             # Live performance plane (tpu_rl.obs.perf): FLOPs/MFU from a
             # one-time AOT cost analysis of train_step, recompile and
             # device-memory watermarks on the emit cadence. None when
@@ -581,10 +601,13 @@ class LearnerService:
                             )
                     if feed.poll_sleep:
                         time.sleep(feed.poll_sleep)
+                    if ledger is not None:
+                        ledger.add(IDLE, time.perf_counter() - t_wait)
                     continue
                 wait_secs = time.perf_counter() - t_wait
                 batch, feed_secs = item
                 key, sub_key = jax.random.split(key)
+                rc0 = self._perf.recompiles if self._perf is not None else 0
                 if self._perf is not None:
                     # Identity check after the first call; first sight of a
                     # (re)built train_step runs the one-time cost analysis
@@ -620,6 +643,15 @@ class LearnerService:
                 timer.record("learner-batching-time", feed_secs)
                 timer.record("learner-queue-wait-time", wait_secs)
                 timer.record("learner-step-time", step_secs)
+                if ledger is not None:
+                    ledger.add(wait_bucket, wait_secs)
+                    # A dispatch that retraced spent its span in XLA, not in
+                    # useful device math — divert it out of compute.
+                    recompiled = (
+                        self._perf is not None
+                        and self._perf.recompiles > rc0
+                    )
+                    ledger.add(RECOMPILE if recompiled else COMPUTE, step_secs)
                 timer.record_gauge("learner-queue-depth", feed.qsize())
                 timer.record(
                     "learner-throughput",
@@ -659,12 +691,18 @@ class LearnerService:
                         jax.block_until_ready(metrics)
                         prof_capture.stop()
                         profiling = False
+                t_pub = time.perf_counter()
                 if _crossed(prev_idx, idx, self.publish_interval):
                     self._publish(pub, state, ver=idx)
                     self._consume_join_flag()  # this broadcast serves joiners
                     last_pub_m = time.monotonic()
                 elif self._maybe_join_push(pub, state, ver=idx):
                     last_pub_m = time.monotonic()
+                if ledger is not None:
+                    # Main-lane broadcast cost only (async dispatch + codec
+                    # handoff); the publisher thread's device_get + send
+                    # overlap the next step and stay off the ledger.
+                    ledger.add(WIRE, time.perf_counter() - t_pub)
                 if telem_reg is not None:
                     now_m = time.monotonic()
                     if now_m - telem_last >= cfg.telemetry_interval_s:
@@ -713,10 +751,15 @@ class LearnerService:
                                     f"cleanly", flush=True,
                                 )
                                 break
+                            t_rb = time.perf_counter()
                             rolled = self._rollback(
                                 ckpt, state, mesh, pub, fingerprint, key,
                                 watchdog.last_reason,
                             )
+                            if ledger is not None:
+                                ledger.add(
+                                    ROLLBACK, time.perf_counter() - t_rb
+                                )
                             if rolled is not None:
                                 state, idx, key = rolled
                                 last_pub_m = time.monotonic()
@@ -733,7 +776,13 @@ class LearnerService:
                 ):
                     # Async mode: snapshot + enqueue only; the D2H, orbax
                     # write, commit marker, and GC run on the writer thread.
+                    t_ck = time.perf_counter()
                     ckpt.save(state, idx, meta=_ckpt_meta())
+                    if ledger is not None:
+                        # The synchronous remnant of the save (device-side
+                        # snapshot + enqueue; the full blocking write when
+                        # async is off). Writer-thread time stays off-ledger.
+                        ledger.add(CKPT, time.perf_counter() - t_ck)
                 self._note_ckpt(timer)
                 if self.heartbeat is not None:
                     self.heartbeat.value = time.time()
@@ -1036,49 +1085,28 @@ class LearnerService:
         """Append one rollback record to result_dir/learner_rollback.jsonl —
         the audit trail heal-smoke asserts against (same contract as
         :meth:`_record_resume`)."""
-        if self.cfg.result_dir is None:
-            return
-        import json
+        from tpu_rl.obs.audit import append_jsonl
 
-        try:
-            os.makedirs(self.cfg.result_dir, exist_ok=True)
-            path = os.path.join(self.cfg.result_dir, "learner_rollback.jsonl")
-            with open(path, "a") as f:
-                f.write(
-                    json.dumps(
-                        {
-                            "idx": idx,
-                            "epoch": self.run_epoch,
-                            "reason": reason,
-                            "nonfinite": self.n_nonfinite_updates,
-                            "t": time.time(),
-                        }
-                    )
-                    + "\n"
-                )
-        except OSError:
-            pass  # durability bookkeeping must never kill the learner
+        append_jsonl(
+            self.cfg.result_dir,
+            "learner_rollback.jsonl",
+            {
+                "idx": idx,
+                "epoch": self.run_epoch,
+                "reason": reason,
+                "nonfinite": self.n_nonfinite_updates,
+            },
+        )
 
     def _record_resume(self, idx: int) -> None:
         """Append one resume record to result_dir/learner_resume.jsonl —
         the audit trail resume-smoke asserts monotonicity against (child
-        stdout is not capturable from the in-process smoke harness)."""
-        if self.cfg.result_dir is None:
-            return
-        import json
+        stdout is not capturable from the in-process smoke harness). The
+        record shape lives in ``obs.audit.append_resume``, shared with the
+        colocated loop (schema equality pinned by test)."""
+        from tpu_rl.obs.audit import append_resume
 
-        try:
-            os.makedirs(self.cfg.result_dir, exist_ok=True)
-            path = os.path.join(self.cfg.result_dir, "learner_resume.jsonl")
-            with open(path, "a") as f:
-                f.write(
-                    json.dumps(
-                        {"idx": idx, "epoch": self.run_epoch, "t": time.time()}
-                    )
-                    + "\n"
-                )
-        except OSError:
-            pass  # durability bookkeeping must never kill the learner
+        append_resume(self.cfg.result_dir, idx, self.run_epoch)
 
     def _emit_telemetry(self, reg, pub: Pub, timer: ExecutionTimer, idx: int
                         ) -> None:
@@ -1088,6 +1116,8 @@ class LearnerService:
         from tpu_rl.obs import LEARNER_VERSION_GAUGE
 
         reg.gauge(LEARNER_VERSION_GAUGE).set(idx)
+        if self.ledger is not None:
+            self.ledger.publish(reg)
         for name, val in timer.scalars().items():
             reg.gauge(name).set(val)
         reg.counter("learner-rebroadcasts").set_total(self.n_rebroadcasts)
@@ -1147,6 +1177,11 @@ class LearnerService:
                 reg.counter("inference-chaos-refusals").set_total(
                     svc.chaos.n_refused
                 )
+            if svc.ledger is not None:
+                # The serve thread's own lane (wait/flush buckets under the
+                # "inference" prefix) — reported, never folded into the
+                # learner's ledger above.
+                svc.ledger.publish(reg)
             if svc.perf is not None:
                 reg.gauge("inference-flops-per-step").set(
                     svc.perf.flops_per_call

@@ -84,6 +84,9 @@ class Manager:
         # when there is nowhere to dump (no result_dir).
         self._tracer = None
         self._trace_path = None
+        # Goodput ledger (tpu_rl.obs.goodput), built in run() iff telemetry
+        # has a sink; None keeps the plane-off loop to one check.
+        self.ledger = None
 
     def run(self) -> None:
         # Fault injection (tpu_rl.chaos): delay:manager shims the forward
@@ -105,12 +108,17 @@ class Manager:
         # Telemetry (tpu_rl.obs): the relay's own health snapshot, emitted
         # on the clock onto the storage-bound PUB. None when the plane has
         # no sink — the loop then pays one `is None` check per iteration.
-        registry = emitter = None
+        registry = emitter = ledger = None
         if self.cfg.telemetry_enabled:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+            from tpu_rl.obs.goodput import COMPUTE, IDLE, WIRE, GoodputLedger
             from tpu_rl.obs.perf import process_self_stats
 
             registry = MetricsRegistry(role="manager")
+            # Goodput ledger: the pump (drain + forward) is the work this
+            # relay exists for — its compute bucket; the bounded idle recv
+            # splits into wire (frame landed) vs idle (timeout).
+            ledger = self.ledger = GoodputLedger("manager")
 
             def _send_snap(snap):
                 # One-way clock-sync stamp: the storage edge pairs our send
@@ -146,7 +154,10 @@ class Manager:
             )
         try:
             while not self._stopped():
+                t_pump = time.perf_counter()
                 moved = self._pump(sub, pub)
+                if ledger is not None:
+                    ledger.add(COMPUTE, time.perf_counter() - t_pump)
                 if registry is not None:
                     registry.counter("manager-forwarded-frames").set_total(
                         self.n_forwarded
@@ -190,6 +201,7 @@ class Manager:
                         rss, n_fds = process_self_stats()
                         registry.gauge("manager-rss-bytes").set(rss)
                         registry.gauge("manager-open-fds").set(float(n_fds))
+                        ledger.publish(registry)
                     if emitter.maybe_emit() and self._tracer is not None:
                         # Trace dumps ride the telemetry cadence so a recent
                         # ring is always on disk for the merger.
@@ -198,7 +210,13 @@ class Manager:
                     self.heartbeat.value = time.time()
                 if not moved:
                     # Idle: block briefly on the socket instead of spinning.
+                    t_recv = time.perf_counter()
                     msg = recv(timeout_ms=50)
+                    if ledger is not None:
+                        ledger.add(
+                            WIRE if msg is not None else IDLE,
+                            time.perf_counter() - t_recv,
+                        )
                     if msg is not None:
                         self._ingest(
                             msg[0],

@@ -172,6 +172,18 @@ class LearnerStorage:
         self._http = None
         self._json_exp = None
         self._tb_exp = None
+        # Goodput plane (tpu_rl.obs.goodput): this loop's own wall-clock
+        # ledger plus the per-wid straggler signals the fleet report is
+        # built from. `_wid_frames` doubles as the plane gate on the ingest
+        # hot path (None when telemetry is off — one `is None` check per
+        # frame, same discipline as the aggregator above).
+        self.ledger = None
+        self._wid_frames = None  # wid -> cumulative admitted frames
+        self._wid_ver = {}  # wid -> last echoed policy version
+        self._wid_rtt = {}  # wid -> rtt EWMA, seconds
+        self._wid_rate = {}  # wid -> frames/s over the last straggler tick
+        self._frames_prev = {}  # wid -> (count, t_mono) at the last tick
+        self._straggler_top = []  # last top-k report (GET /goodput)
         # SLO engine (tpu_rl.obs.slo): storage owns fleet-wide evaluation —
         # it already aggregates every role's snapshots. Evaluated on a 1s
         # cadence (not per frame); /slo serves the last verdict. None unless
@@ -223,15 +235,28 @@ class LearnerStorage:
         )
         self._setup_trace(assembler)
         self._setup_telemetry()
+        led = self.ledger
+        if led is not None:
+            from tpu_rl.obs.goodput import COMPUTE, IDLE, WIRE
         try:
             while not self._stopped():
                 self._poll_epoch()
+                t_recv = time.perf_counter()
                 msg = sub.recv_traced(timeout_ms=50)
+                t_work = time.perf_counter()
+                if led is not None:
+                    # The bounded recv is the loop's only wait: wire time
+                    # when a frame landed, idle when the fleet was quiet.
+                    led.add(WIRE if msg is not None else IDLE, t_work - t_recv)
                 if msg is not None:
                     self._ingest(msg[0], msg[1], assembler, msg[2])
                 for proto, payload, trailer in sub.drain_traced():
                     self._ingest(proto, payload, assembler, trailer)
                 self._flush(assembler, store)
+                if led is not None:
+                    # Ingest + assembly + window flush: the work this role
+                    # exists for — its compute bucket.
+                    led.add(COMPUTE, time.perf_counter() - t_work)
                 now_m = time.monotonic()
                 if now_m >= self._next_evict:
                     self._next_evict = now_m + 1.0
@@ -316,6 +341,7 @@ class LearnerStorage:
         if not cfg.telemetry_enabled:
             return
         from tpu_rl.obs import (
+            GoodputLedger,
             JsonExporter,
             MetricsRegistry,
             ProfilerCapture,
@@ -330,6 +356,8 @@ class LearnerStorage:
             registry=MetricsRegistry(role="storage"),
             stale_after_s=cfg.telemetry_stale_s,
         )
+        self.ledger = GoodputLedger("storage")
+        self._wid_frames = {}
         self._slo = maybe_slo_engine(cfg)
         if cfg.result_dir is not None:
             self._prof = ProfilerCapture(os.path.join(cfg.result_dir, "prof"))
@@ -342,6 +370,7 @@ class LearnerStorage:
                 prof=(
                     self._prof.capture_async if self._prof is not None else None
                 ),
+                goodput=self._goodput_payload,
             )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
@@ -432,9 +461,24 @@ class LearnerStorage:
             rss, n_fds = process_self_stats()
             reg.gauge("storage-rss-bytes").set(rss)
             reg.gauge("storage-open-fds").set(float(n_fds))
+            if self.ledger is not None:
+                self.ledger.publish(reg)
+            if self._wid_frames:
+                # Straggler gauges BEFORE the SLO pass so rules over
+                # worker-straggler-score see this second's values.
+                self._straggler_tick(reg, now_m)
             if self._slo is not None:
                 self._slo.evaluate(self.aggregator)
         if self._json_exp is not None and self._json_exp.maybe_export():
+            if self.ledger is not None:
+                # Ledger + straggler audit trail on the exporter's cadence:
+                # one JSON line per export, the offline twin of GET /goodput.
+                from tpu_rl.obs.audit import append_jsonl
+
+                append_jsonl(
+                    self.cfg.result_dir, "goodput.jsonl",
+                    self._goodput_payload(),
+                )
             if self._tb_exp is not None:
                 self._tb_exp.export(self.aggregator)
             if self._tracer is not None:
@@ -444,6 +488,74 @@ class LearnerStorage:
                     self._trace_path,
                     extra_meta={"clock": self.clocksync.snapshot()},
                 )
+
+    def _straggler_tick(self, reg, now_m: float) -> None:
+        """Refresh the per-wid straggler signals and score gauges (1 Hz).
+
+        Three signals, robust z-scored against the fleet median
+        (tpu_rl.obs.goodput.straggler_report): admitted-frame rate over the
+        last tick window, policy staleness vs the aggregator's version
+        ratchet, and the clock-sync rtt EWMA. Report-only — quarantine (the
+        heal plane) stays the enforcement arm."""
+        from tpu_rl.obs.goodput import STRAGGLER_GAUGE, straggler_report
+
+        rates = {}
+        for wid, count in self._wid_frames.items():
+            prev = self._frames_prev.get(wid)
+            if prev is not None and now_m > prev[1]:
+                rates[wid] = (count - prev[0]) / (now_m - prev[1])
+            self._frames_prev[wid] = (count, now_m)
+        self._wid_rate = rates
+        floor = self.aggregator.max_version
+        staleness = {
+            wid: float(max(0, floor - ver))
+            for wid, ver in self._wid_ver.items()
+        }
+        scores, top = straggler_report(
+            frame_rate=rates or None,
+            staleness=staleness or None,
+            rtt=dict(self._wid_rtt) or None,
+        )
+        self._straggler_top = top
+        for wid, score in scores.items():
+            reg.gauge(STRAGGLER_GAUGE, {"wid": str(wid)}).set(score)
+
+    def _goodput_payload(self) -> dict:
+        """The GET /goodput document: this loop's own ledger snapshot, every
+        source's published goodput/bucket gauges (rebuilt from the
+        aggregator, keyed ``role/pid``), and the straggler top-k."""
+        roles: dict = {}
+        if self.aggregator is not None:
+            for snap, _age in self.aggregator.all_snapshots():
+                role = str(snap.get("role", "?"))
+                ratios: dict = {}
+                goodput = overcommit = None
+                for name, _labels, value in snap.get("gauges", ()):
+                    if name == role + "-goodput-ratio":
+                        goodput = value
+                    elif name.startswith(role + "-time-") and name.endswith(
+                        "-ratio"
+                    ):
+                        bucket = name[len(role) + 6 : -6]
+                        if bucket == "overcommit":
+                            overcommit = value
+                        else:
+                            ratios[bucket] = value
+                if goodput is None and not ratios:
+                    continue
+                roles[f"{role}/{snap.get('pid', '?')}"] = {
+                    "goodput": goodput,
+                    "ratios": ratios,
+                    "overcommit_ratio": overcommit,
+                }
+        return {
+            "storage": (
+                self.ledger.snapshot() if self.ledger is not None else None
+            ),
+            "roles": roles,
+            "stragglers": self._straggler_top,
+            "rates": {str(w): r for w, r in self._wid_rate.items()},
+        }
 
     def _close_telemetry(self) -> None:
         if self._http is not None:
@@ -499,6 +611,12 @@ class LearnerStorage:
                     self.aggregator.observe_staleness(
                         int(payload.get("wid", -1)), ver
                     )
+                if self._wid_frames is not None:
+                    wid = payload.get("wid")
+                    if isinstance(wid, int):
+                        self._wid_frames[wid] = self._wid_frames.get(wid, 0) + 1
+                        if isinstance(ver, int):
+                            self._wid_ver[wid] = ver
             trace_id = None
             if trailer is not None and self._tracer is not None:
                 trace_id = self._note_ingest(trailer)
@@ -655,6 +773,15 @@ class LearnerStorage:
         t0, t1 = clk.get("t0"), clk.get("t1")
         if isinstance(t0, int) and isinstance(t1, int):
             self.clocksync.add_round_trip(key, t0, t1, t2, t3)
+            wid = payload.get("wid")
+            if isinstance(wid, int):
+                # Per-wid transport rtt (minus the remote's hold time) as a
+                # straggler signal — EWMA so one slow scrape doesn't flag.
+                rtt_s = max(0.0, ((t3 - t0) - (t2 - t1)) / 1e9)
+                prev = self._wid_rtt.get(wid)
+                self._wid_rtt[wid] = (
+                    rtt_s if prev is None else 0.8 * prev + 0.2 * rtt_s
+                )
         else:
             self.clocksync.add_one_way(key, t2, t3)
 

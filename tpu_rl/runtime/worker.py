@@ -202,13 +202,20 @@ class Worker:
         # frame so storage can fence out frames acted under a pre-crash
         # learner incarnation (unknown is always accepted).
         run_epoch = -1
+        ledger = None
         if cfg.telemetry_enabled:
             from tpu_rl.obs import MetricsRegistry, PeriodicSnapshot
+            from tpu_rl.obs.goodput import COMPUTE, IDLE, WIRE, GoodputLedger
             from tpu_rl.obs.perf import process_self_stats
 
             registry = MetricsRegistry(
                 role="worker", labels={"wid": str(self.worker_id)}
             )
+            # Goodput ledger: act + env stepping is this role's compute
+            # (remote acting included — outsourced or not, it is the tick's
+            # purposeful work); model-SUB drains and the rollout publish are
+            # wire; the reference throttle sleep is idle.
+            ledger = self.ledger = GoodputLedger("worker")
 
             def _send_snap(snap, _wid=self.worker_id):
                 snap["wid"] = _wid  # aggregator source key + UI grouping
@@ -325,6 +332,7 @@ class Worker:
                         trace_id = make_trace_id(self.worker_id, tick_seq)
                 # Hot-reload the freshest broadcast params (reference
                 # ``req_model`` task, ``worker.py:62-72``).
+                t_drain = time.perf_counter()
                 for proto, payload in model_sub.drain(max_msgs=MODEL_HWM):
                     if proto == Protocol.Model:
                         params = {"actor": payload["actor"]}
@@ -338,6 +346,9 @@ class Worker:
                             if isinstance(t_tx, int):
                                 clk_echo = [t_tx, time.time_ns()]
 
+                t_act = time.perf_counter()
+                if ledger is not None:
+                    ledger.add(WIRE, t_act - t_drain)
                 if remote is not None:
                     t_rtt = time.perf_counter()
                     reply = remote.act(obs, is_fir)
@@ -467,6 +478,11 @@ class Worker:
                         obs[i] = env.reset()
                         episode_ids[i] = uuid.uuid4().hex
                         is_fir[i], epi_rew[i], epi_steps[i] = 1.0, 0.0, 0
+                t_built = time.perf_counter()
+                if ledger is not None:
+                    # Policy forward + env stepping (episode-end stat sends
+                    # are rare and ride inside the span — sub-ms noise).
+                    ledger.add(COMPUTE, t_built - t_act)
                 # Version echo: remote ticks acted with the server's params
                 # (the reply says which update produced them); local ticks
                 # acted with the last broadcast. Extra keys are ignored by
@@ -502,6 +518,8 @@ class Worker:
                 if dchaos is not None:
                     dchaos.on_tick(tick_payload)
                 pub.send(Protocol.RolloutBatch, tick_payload, trace=trailer)
+                if ledger is not None:
+                    ledger.add(WIRE, time.perf_counter() - t_built)
                 if sampled and tracer is not None:
                     tracer.add(
                         "worker-tick",
@@ -592,6 +610,7 @@ class Worker:
                         rss, n_fds = process_self_stats()
                         registry.gauge("worker-rss-bytes").set(rss)
                         registry.gauge("worker-open-fds").set(float(n_fds))
+                        ledger.publish(registry)
                     if emitter.maybe_emit() and tracer is not None:
                         # Trace dumps ride the telemetry cadence: no clock
                         # of their own, and a crash between dumps still
@@ -604,6 +623,8 @@ class Worker:
                     # Applies per tick (= per batched act), so N envs yield
                     # N env-steps per throttle window.
                     time.sleep(cfg.worker_step_sleep)
+                    if ledger is not None:
+                        ledger.add(IDLE, cfg.worker_step_sleep)
         finally:
             if tracer is not None and tracer.n_recorded:
                 tracer.dump(trace_path)
