@@ -4,8 +4,8 @@ PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
-	resume-smoke slo-smoke loadgen-smoke heal-smoke pbt-smoke \
-	goodput-smoke ci
+	resume-smoke slo-smoke loadgen-smoke serving-smoke heal-smoke \
+	pbt-smoke goodput-smoke ci
 
 lint:
 	ruff check .
@@ -111,6 +111,14 @@ slo-smoke:
 loadgen-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/loadgen_smoke.py
 
+# Serving fast-path smoke: a two-replica fleet serving bf16-quantized
+# params through the bucket ladder [8, 16] — mixed-width sweep with zero
+# client failures, live replica counters holding inference-xla-recompiles
+# at exactly 0 post-warm, and a live parity spot-check of the quantized
+# reply logits against the local f32 reference act.
+serving-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/serving_smoke.py
+
 # Self-healing smoke: in-jit guard bit-identity + NaN containment, then a
 # NaN/spike data-chaos cluster run — >=1 watchdog rollback to a committed
 # checkpoint with an epoch fence, the poisoned worker quarantined and later
@@ -137,4 +145,4 @@ goodput-smoke:
 
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
-	loadgen-smoke heal-smoke pbt-smoke goodput-smoke
+	loadgen-smoke serving-smoke heal-smoke pbt-smoke goodput-smoke

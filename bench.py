@@ -1000,6 +1000,179 @@ def run_act_compare(
     return result
 
 
+# ------------------------------------------------------- serving fast path
+def run_serving_fastpath(
+    clients: int | None = None,
+    envs_per_client: int | None = None,
+    acts: int | None = None,
+    port: int = 29930,
+    out_path: str | None = None,
+) -> dict:
+    """Serving fast-path A/B ladder (ISSUE 16): the SAME closed-loop client
+    load against the production ``InferenceService``, once per knob
+    combination of the three composable layers —
+
+    - ``inference_dtype``  f32 (PR 12 baseline) vs bf16 vs int8 serving
+      params (per-tensor symmetric, dequantized inside the jitted step);
+    - ``inference_buckets`` 0 (single ``pad_rows`` program — every flush
+      pays the largest padded shape) vs a power-of-two ladder, where a
+      flush dispatches the smallest covering pre-warmed program;
+    - ``act_kernel`` xla vs the fused Pallas act step (TPU-only at run
+      time; rows record ``kernel_active`` so a CPU capture can never be
+      misread as a kernel number).
+
+    The load is deliberately SMALL-FLUSH (default 2 clients x 4 envs = 8-row
+    flushes against ``pad_rows`` 64): the over-padding the bucket ladder
+    removes is exactly the PR 12 ``pad_rows = max(inference_batch,
+    worker_num_envs)`` fixed cost. Per row: acts/s, client-observed p99 RTT,
+    the post-warm recompile count (must stay 0 — the serving ratchet), the
+    quantized param-tree bytes and the per-bucket flush split. Headline
+    deltas: ``composed_speedup`` (bf16+buckets vs baseline acts/s) and
+    ``composed_p99_ratio`` (tail parity)."""
+    import tempfile
+    import threading
+
+    from tpu_rl.config import Config
+    from tpu_rl.models.families import build_family
+    from tpu_rl.runtime.inference_service import (
+        InferenceClient,
+        InferenceService,
+    )
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if clients is None:
+        clients = 2
+    if envs_per_client is None:
+        envs_per_client = 4
+    if acts is None:
+        acts = 300 if on_cpu else 1000
+    if out_path is None:
+        out_path = "bench_serving.cpu.json" if on_cpu else "bench_serving.json"
+
+    base = dict(
+        # Wide torso + large padded batch: the serving shape where the
+        # PR 12 fixed pad is real money — every 8-row flush below pays a
+        # 256-row LSTM step unless a smaller bucket program covers it.
+        algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=256,
+        worker_num_envs=envs_per_client, act_mode="remote",
+        inference_batch=256, inference_flush_us=500,
+        inference_timeout_ms=30_000,
+        # telemetry on: installs the per-bucket PerfTracker recompile
+        # watches the ratchet column reads
+        result_dir=tempfile.mkdtemp(prefix="bench-serving-"),
+        telemetry_interval_s=3600.0,
+    )
+    cases = [
+        ("baseline-f32", dict()),
+        ("bf16", dict(inference_dtype="bf16")),
+        ("buckets", dict(inference_buckets=8)),
+        ("composed-bf16-buckets",
+         dict(inference_dtype="bf16", inference_buckets=8)),
+        ("int8-buckets",
+         dict(inference_dtype="int8", inference_buckets=8)),
+        ("pallas-composed",
+         dict(inference_dtype="bf16", inference_buckets=8,
+              act_kernel="pallas")),
+    ]
+
+    rows = []
+    for i, (name, knobs) in enumerate(cases):
+        cfg = Config.from_dict({**base, **knobs})
+        family = build_family(cfg)
+        params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        svc = InferenceService(
+            cfg, family, params, port=port + i, seed=0
+        ).start()
+        try:
+            assert svc.wait_ready(300.0) and svc.error is None, svc.error
+            barrier = threading.Barrier(clients + 1)
+            failures = [0] * clients
+            lat: list[list[float]] = [[] for _ in range(clients)]
+
+            def drive(k: int, _port: int = port + i) -> None:
+                cl = InferenceClient(cfg, "127.0.0.1", _port, wid=k)
+                try:
+                    rng = np.random.default_rng(k)
+                    obs = rng.standard_normal(
+                        (envs_per_client, int(cfg.obs_shape[0]))
+                    ).astype(np.float32)
+                    first = np.ones(envs_per_client, np.float32)
+                    cl.act(obs, first)  # join + prime outside timed region
+                    barrier.wait()
+                    first = np.zeros(envs_per_client, np.float32)
+                    for _ in range(acts):
+                        t0 = time.perf_counter()
+                        if cl.act(obs, first) is None:
+                            failures[k] += 1
+                        lat[k].append(time.perf_counter() - t0)
+                finally:
+                    cl.close()
+
+            threads = [
+                threading.Thread(target=drive, args=(k,), daemon=True)
+                for k in range(clients)
+            ]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            all_lat = sorted(x for ks in lat for x in ks)
+            p99 = all_lat[int(0.99 * (len(all_lat) - 1))] if all_lat else None
+            rows.append({
+                "name": name,
+                "inference_dtype": cfg.inference_dtype,
+                "inference_buckets": cfg.inference_buckets,
+                "act_kernel": cfg.act_kernel,
+                # the fused kernel only engages on a single-device TPU
+                # backend; everywhere else make_act_fn falls back to the
+                # XLA act so this row is a dispatch-overhead check on CPU
+                "kernel_active": (
+                    cfg.act_kernel == "pallas" and not on_cpu
+                    and len(jax.devices()) == 1
+                ),
+                "acts_per_s": round(clients * acts * envs_per_client / dt, 1),
+                "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+                "recompiles": svc.recompiles,
+                "param_bytes": svc.param_bytes,
+                "bucket_flushes": {
+                    str(k): v for k, v in sorted(svc.n_flush_bucket.items())
+                },
+                "client_failures": sum(failures),
+            })
+        finally:
+            svc.close()
+
+    by_name = {r["name"]: r for r in rows}
+    base_row = by_name["baseline-f32"]
+    comp_row = by_name["composed-bf16-buckets"]
+    result = {
+        "metric": "serving fast path A/B (dtype x buckets x kernel)",
+        "device_kind": jax.devices()[0].device_kind,
+        "clients": clients,
+        "envs_per_client": envs_per_client,
+        "acts_per_client": acts,
+        "pad_rows": 256,
+        "rows": rows,
+        "composed_speedup": round(
+            comp_row["acts_per_s"] / base_row["acts_per_s"], 3
+        ),
+        "composed_p99_ratio": (
+            round(comp_row["p99_ms"] / base_row["p99_ms"], 3)
+            if comp_row["p99_ms"] and base_row["p99_ms"] else None
+        ),
+        "recompiles_total": sum(r["recompiles"] for r in rows),
+        "client_failures_total": sum(r["client_failures"] for r in rows),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result), file=sys.stderr, flush=True)
+    return result
+
+
 # ------------------------------------------------------------- relay A/B
 def _relay_tick_payload(n_envs: int = 32, hidden: int = 64) -> dict:
     """One worker tick at the reference quantum (CartPole (4,)/2 discrete,
@@ -1669,6 +1842,12 @@ if __name__ == "__main__":
         # round-trips, on whatever backend jax resolved. See also
         # examples/bench_remote_acting.py for the parameterized CLI.
         print(json.dumps(run_act_compare()))
+        sys.exit(0)
+    if os.environ.get("TPU_RL_BENCH_SERVING"):
+        # Serving fast-path A/B mode (ISSUE 16): the quantized-dtype x
+        # bucket-ladder x act-kernel matrix against the production
+        # InferenceService, small-flush load vs the padded baseline.
+        print(json.dumps(run_serving_fastpath()))
         sys.exit(0)
     if os.environ.get("TPU_RL_BENCH_E2E"):
         # e2e feed A/B mode: sync vs prefetched LearnerService through the

@@ -12,7 +12,14 @@ CPU-scale proof of ISSUE 12's acceptance bar:
   overall success must stay >= 99.9%, and the per-stage version floor must
   never decrease (the fleet's monotonic-weights guarantee under churn);
 - the sub-saturation first stage must grade GREEN on
-  ``p99:inference-rtt``.
+  ``p99:inference-rtt``;
+- the replicas serve through a BUCKET LADDER (``inference_buckets=8``) with
+  telemetry on, and every stage is graded against the replicas' live stat
+  snapshots on ``counter:inference-xla-recompiles==0`` — the PR 11
+  recompile ratchet as an SLO: all bucket programs compile before the
+  socket binds, so a sweep across flush sizes must never hit XLA again.
+  Each stage's verdict must be a hard GREEN (``ok is True``), never
+  no-data.
 
 Exits nonzero on any failure — this is the ``make loadgen-smoke`` CI gate.
 
@@ -34,7 +41,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SLO_SPEC = "p99:inference-rtt<250ms@window=60s"
+SLO_SPEC = (
+    "p99:inference-rtt<250ms@window=60s,"
+    "counter:inference-xla-recompiles==0"
+)
 
 
 def main() -> int:
@@ -56,9 +66,11 @@ def main() -> int:
     from tpu_rl.loadgen import probe_ready, run_loadgen
     from tpu_rl.models.families import build_family
     from tpu_rl.runtime.protocol import Protocol
-    from tpu_rl.runtime.transport import MODEL_HWM, Pub
+    from tpu_rl.runtime.transport import MODEL_HWM, Pub, Sub
 
     model_port = args.base_port + 10
+    stat_port = args.base_port + 11
+    result_dir = args.result_dir or tempfile.mkdtemp(prefix="loadgen-smoke-")
     cfg = Config.from_dict(dict(
         algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=32,
         worker_num_envs=1, act_mode="remote",
@@ -66,10 +78,14 @@ def main() -> int:
         inference_batch=16, inference_flush_us=500,
         inference_timeout_ms=1500, inference_hedge_ms=150,
         inference_retries=1,
+        # Bucket-ladder sweep (ladder [8, 16]) with telemetry on
+        # (result_dir flips telemetry_enabled): the recompile-ratchet SLO
+        # below grades the replicas' own counters live.
+        inference_buckets=8, result_dir=result_dir,
+        telemetry_interval_s=1.0,
     ))
     ports = [args.base_port, args.base_port + 1]
     endpoints = [("127.0.0.1", prt) for prt in ports]
-    result_dir = args.result_dir or tempfile.mkdtemp(prefix="loadgen-smoke-")
     out_path = os.path.join(result_dir, "loadgen.json")
     rates = [float(r) for r in args.rates.split(",")]
 
@@ -94,12 +110,29 @@ def main() -> int:
         ctx.Process(
             target=replica_main,
             args=(cfg, i, ports[i], "127.0.0.1", model_port,
-                  cfg.telemetry_port or args.base_port + 11, None, None),
+                  stat_port, None, None),
             kwargs={"seed": 0},
             daemon=True,
         )
         for i in range(2)
     ]
+
+    # Server-side telemetry tap: the replicas' stat PUBs connect out to
+    # learner_ip:stat_port, so the smoke binds the SUB end and keeps each
+    # replica's LATEST snapshot — the extra grading input for the
+    # recompile-ratchet SLO (a killed replica's last snapshot keeps
+    # counting: its pre-kill recompiles stay in the fleet sum).
+    stat_sub = Sub("*", stat_port, bind=True)
+    latest: dict[int, dict] = {}
+    stop_stats = threading.Event()
+
+    def _collect_stats() -> None:
+        while not stop_stats.is_set():
+            for proto, snap in stat_sub.drain(max_msgs=256):
+                if proto == Protocol.Telemetry and isinstance(snap, dict):
+                    latest[int(snap.get("rid", -1))] = snap
+            stop_stats.wait(0.1)
+
     killer = None
     try:
         for proc in replicas:
@@ -109,6 +142,16 @@ def main() -> int:
             print("[loadgen] FAIL: fleet never became ready", flush=True)
             return 1
         threading.Thread(target=_publish, daemon=True).start()
+        threading.Thread(target=_collect_stats, daemon=True).start()
+        # First replica snapshots must land before grading starts, so the
+        # recompile rule can never grade no-data on stage 0.
+        t_wait = time.monotonic() + 30.0
+        while len(latest) < 2 and time.monotonic() < t_wait:
+            time.sleep(0.2)
+        if len(latest) < 2:
+            print("[loadgen] FAIL: replica telemetry never arrived",
+                  flush=True)
+            return 1
 
         # The chaos leg: replica 1 dies -9 mid-sweep (stage 2 at the
         # defaults). No respawn — the surviving replica must carry the
@@ -125,12 +168,15 @@ def main() -> int:
             cfg, endpoints, n_clients=args.clients, rates=rates,
             duration_s=args.duration, out_path=out_path, n_procs=2,
             rows=1, slo_spec=SLO_SPEC,
+            extra_snapshots=lambda: list(latest.values()),
         )
     finally:
         if killer is not None:
             killer.cancel()
         stop_pub.set()
+        stop_stats.set()
         pub.close()
+        stat_sub.close()
         for proc in replicas:
             if proc.is_alive():
                 proc.kill()
@@ -163,6 +209,21 @@ def main() -> int:
         failures.append(
             f"sub-saturation stage SLO not green: {first_slo}"
         )
+    # Recompile ratchet across the bucket-ladder sweep: EVERY stage's
+    # counter:inference-xla-recompiles==0 rule must grade a hard GREEN.
+    # ok=None (no-data) is a failure too — it would mean the replicas'
+    # snapshots never reached the grading set and the ratchet was not
+    # actually checked.
+    for i, stage in enumerate(doc["stages"]):
+        rules = (stage.get("slo") or {}).get("rules", [])
+        rule = next(
+            (r for r in rules if r["metric"] == "inference-xla-recompiles"),
+            None,
+        )
+        if rule is None or rule["ok"] is not True:
+            failures.append(
+                f"stage {i}: recompile ratchet not green: {rule}"
+            )
     absorbed = sum(
         s["hedges"] + s["failovers"] for s in doc["stages"][1:]
     )

@@ -1,0 +1,73 @@
+"""Serving fast-path bench (ISSUE 16): schema + direction checks on
+``bench.run_serving_fastpath`` — slow-marked (it boots six real
+InferenceService configs); tier-1 stays fast. Directions asserted are the
+ones the PR's acceptance bar names: buckets beat the padded baseline on
+small flushes, the ratchet stays at 0 recompiles across the whole matrix,
+and quantized rows report the shrunken param footprint."""
+
+import json
+
+import pytest
+
+import bench
+
+pytestmark = pytest.mark.slow
+
+ROW_KEYS = {
+    "name", "inference_dtype", "inference_buckets", "act_kernel",
+    "kernel_active", "acts_per_s", "p99_ms", "recompiles", "param_bytes",
+    "bucket_flushes", "client_failures",
+}
+CASE_NAMES = [
+    "baseline-f32", "bf16", "buckets", "composed-bf16-buckets",
+    "int8-buckets", "pallas-composed",
+]
+
+
+@pytest.fixture(scope="module")
+def doc(tmp_path_factory):
+    out = tmp_path_factory.mktemp("serving") / "bench_serving.json"
+    return bench.run_serving_fastpath(
+        clients=2, envs_per_client=2, acts=60, port=30990,
+        out_path=str(out),
+    ), out
+
+
+def test_schema_and_artifact(doc):
+    result, out = doc
+    assert [r["name"] for r in result["rows"]] == CASE_NAMES
+    for row in result["rows"]:
+        assert set(row) == ROW_KEYS, row["name"]
+    on_disk = json.loads(out.read_text())
+    assert on_disk["metric"] == result["metric"]
+    assert on_disk["recorded_at"][:3] == "202"
+    assert result["pad_rows"] == 256
+
+
+def test_directions(doc):
+    result, _ = doc
+    by = {r["name"]: r for r in result["rows"]}
+    # the serving ratchet: every config compiles pre-bind, then never again
+    assert result["recompiles_total"] == 0
+    assert result["client_failures_total"] == 0
+    # small flushes must dispatch the small bucket, never the 256 pad
+    assert set(by["composed-bf16-buckets"]["bucket_flushes"]) == {"8"}
+    assert set(by["baseline-f32"]["bucket_flushes"]) == {"256"}
+    # quantization shrinks the served tree: int8 < bf16 < f32
+    assert by["int8-buckets"]["param_bytes"] \
+        < by["bf16"]["param_bytes"] < by["baseline-f32"]["param_bytes"]
+    # the composed fast path beats the PR 12 padded baseline on throughput
+    # (the acceptance capture in bench_serving.cpu.json shows >= 1.5x; the
+    # light in-test shape keeps a safety margin against 1-core CI noise)
+    assert result["composed_speedup"] >= 1.2, result["composed_speedup"]
+    # ... at a tail no worse than the baseline's
+    assert result["composed_p99_ratio"] is not None
+    assert result["composed_p99_ratio"] <= 1.1, result["composed_p99_ratio"]
+
+
+def test_cpu_rows_never_claim_the_kernel(doc):
+    result, _ = doc
+    by = {r["name"]: r for r in result["rows"]}
+    assert by["pallas-composed"]["act_kernel"] == "pallas"
+    if result["device_kind"].lower().startswith(("cpu", "host")):
+        assert by["pallas-composed"]["kernel_active"] is False

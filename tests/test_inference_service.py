@@ -479,3 +479,172 @@ class TestStatPlumbing:
                                  stat_array=sa)
         storage._relay_stat({"mean": 1.0, "n": 50})
         assert sa[2] == 1.0
+
+
+# ----------------------------------------------- serving fast path (ISSUE 16)
+class TestBucketLadder:
+    """Shape-bucketed recompile-free batching: ladder construction, smallest-
+    covering dispatch, the single-bucket legacy fallback, and the warm-time
+    compile guarantee (0 post-warm recompiles across a flush-size sweep)."""
+
+    def _ladder(self, **kw):
+        cfg = _svc_config(**kw)
+        family = build_family(cfg)
+        params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        return InferenceService(cfg, family, params, port=0)._bucket_ladder()
+
+    def test_ladder_shapes(self):
+        assert self._ladder(inference_batch=64) == [64]  # legacy fallback
+        assert self._ladder(inference_batch=64, inference_buckets=8) == \
+            [8, 16, 32, 64]
+        assert self._ladder(inference_batch=64, inference_buckets=6) == \
+            [8, 16, 32, 64]  # floor rounds up to a power of two
+        assert self._ladder(inference_batch=64, inference_buckets=64) == [64]
+        assert self._ladder(inference_batch=48, inference_buckets=8) == \
+            [8, 16, 32, 48]  # top bucket is pad_rows itself, not a pow2
+        # worker_num_envs can set the pad when it exceeds inference_batch
+        assert self._ladder(
+            inference_batch=8, worker_num_envs=32, inference_buckets=8
+        ) == [8, 16, 32]
+
+    @staticmethod
+    def _wait_bucket(svc, bucket, want, timeout=5.0):
+        """The flush counter increments after the reply send — poll briefly
+        so the assertion does not race the service thread."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if svc.n_flush_bucket.get(bucket, 0) >= want:
+                return True
+            time.sleep(0.01)
+        return False
+
+    def test_dispatch_uses_smallest_covering_bucket(self):
+        port = BASE + 60
+        cfg, family, params, svc = _start_service(
+            port, inference_batch=16, inference_buckets=4,
+            inference_flush_us=200, worker_num_envs=16,
+        )
+        try:
+            cl = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+            try:
+                for n, want_bucket in ((3, 4), (4, 4), (5, 8), (11, 16)):
+                    before = svc.n_flush_bucket.get(want_bucket, 0)
+                    got = cl.act(_obs(n, cfg), np.ones(n, np.float32))
+                    assert got is not None and got["act"].shape[0] == n
+                    assert self._wait_bucket(svc, want_bucket, before + 1), (
+                        n, want_bucket, dict(svc.n_flush_bucket)
+                    )
+            finally:
+                cl.close()
+        finally:
+            svc.close()
+
+    def test_single_bucket_fallback_counts_pad_rows(self):
+        port = BASE + 61
+        cfg, family, params, svc = _start_service(
+            port, inference_batch=8, inference_flush_us=200
+        )
+        try:
+            assert svc.buckets == [8]
+            cl = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+            try:
+                assert cl.act(_obs(2, cfg), np.ones(2, np.float32)) is not None
+            finally:
+                cl.close()
+            assert TestBucketLadder._wait_bucket(svc, 8, 1), dict(
+                svc.n_flush_bucket
+            )
+            assert set(svc.n_flush_bucket) == {8}
+        finally:
+            svc.close()
+
+    def test_no_recompiles_across_bucket_sweep(self, tmp_path):
+        """The PR 11 ratchet at every ladder shape: telemetry installs a
+        per-bucket recompile watch; sweeping flush sizes across all bucket
+        programs (and a mid-sweep param swap) must never hit XLA again."""
+        port = BASE + 62
+        cfg, family, params, svc = _start_service(
+            port, inference_batch=16, inference_buckets=4,
+            inference_flush_us=200, worker_num_envs=16,
+            result_dir=str(tmp_path),
+        )
+        try:
+            assert set(svc.perf_buckets) == {4, 8, 16}
+            cl = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+            try:
+                for n in (1, 4, 5, 9, 16, 2, 13):
+                    assert cl.act(_obs(n, cfg), np.ones(n, np.float32)) \
+                        is not None
+                # a model-broadcast-style swap (host numpy tree, like the
+                # wire decoder hands over) must land on the same programs
+                host = jax.tree_util.tree_map(
+                    np.asarray, jax.device_get(params["actor"])
+                )
+                svc.set_params({"actor": host}, version=2)
+                for n in (1, 5, 9):
+                    assert cl.act(_obs(n, cfg), np.ones(n, np.float32)) \
+                        is not None
+            finally:
+                cl.close()
+            assert svc.recompiles == 0, {
+                b: t.recompiles for b, t in svc.perf_buckets.items()
+            }
+        finally:
+            svc.close()
+
+
+class TestQuantizedServing:
+    def test_bf16_service_parity_and_footprint(self, tmp_path):
+        """End-to-end through the wire: a bf16-serving service must agree
+        with the f32 reference act on argmax at real margins and report the
+        halved param footprint."""
+        port = BASE + 63
+        cfg, family, params, svc = _start_service(
+            port, inference_dtype="bf16", inference_flush_us=200,
+            result_dir=str(tmp_path), hidden_size=32,
+        )
+        try:
+            assert 0 < svc.param_bytes < sum(
+                np.asarray(x).nbytes
+                for x in jax.tree_util.tree_leaves(params["actor"])
+            )
+            cl = InferenceClient(cfg, "127.0.0.1", port, wid=0)
+            try:
+                obs = _obs(4, cfg, seed=3)
+                got = cl.act(obs, np.ones(4, np.float32))
+            finally:
+                cl.close()
+            assert got is not None
+            import jax.numpy as jnp
+
+            hw, cw = family.carry_widths
+            _a, ref_logits, _lp, _h2, _c2 = family.act(
+                params, jnp.asarray(obs), jnp.zeros((4, hw)),
+                jnp.zeros((4, cw)), jax.random.key(0),
+            )
+            np.testing.assert_allclose(
+                got["logits"], np.asarray(ref_logits), atol=5e-2
+            )
+        finally:
+            svc.close()
+
+    def test_int8_set_params_roundtrip(self):
+        """Swaps re-quantize on arrival: after a ver-keyed swap the served
+        tree is int8-compressed, and stale swaps stay no-ops."""
+        from tpu_rl.fleet import InferenceReplica
+        from tpu_rl.models.quant import is_q8_leaf
+
+        cfg = _svc_config(inference_dtype="int8")
+        family = build_family(cfg)
+        params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+        svc = InferenceReplica(cfg, family, params, port=0, version=1)
+        svc._params = svc._quantize(svc._params)
+        svc.set_params(params, version=5)
+        q8 = [
+            leaf for leaf in jax.tree_util.tree_leaves(
+                svc._params, is_leaf=is_q8_leaf
+            ) if is_q8_leaf(leaf)
+        ]
+        assert q8, "int8 swap did not quantize"
+        svc.set_params(params, version=4)  # stale: refused
+        assert svc.n_stale_sets == 1 and svc.version == 5

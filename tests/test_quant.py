@@ -1,0 +1,189 @@
+"""Quantized serving params (ISSUE 16): tree transforms, idempotence, the
+spec map, and — the bar that matters — POLICY parity of the quantized act
+path against f32 at trained-policy-like logit margins: bf16/int8 serving
+must not flip actions or drift log-probs beyond sampling noise."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tests.conftest import small_config
+from tpu_rl.models.families import build_family
+from tpu_rl.models.quant import (
+    QUANT_MODES,
+    dequantize_tree,
+    is_q8_leaf,
+    quant_spec,
+    quantize_tree,
+    tree_bytes,
+)
+
+
+def _params(cfg):
+    family = build_family(cfg)
+    return family, family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+
+
+# ------------------------------------------------------------ transforms
+class TestQuantizeTree:
+    def test_f32_is_identity(self):
+        _, params = _params(small_config())
+        out = quantize_tree(params["actor"], "f32")
+        for a, b in zip(
+            jax.tree.leaves(params["actor"]), jax.tree.leaves(out),
+            strict=True,
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_bf16_casts_float_leaves(self):
+        _, params = _params(small_config())
+        out = quantize_tree(params["actor"], "bf16")
+        for leaf in jax.tree.leaves(out):
+            assert leaf.dtype == jnp.bfloat16
+
+    def test_int8_quantizes_matrices_keeps_biases(self):
+        _, params = _params(small_config())
+        out = quantize_tree(params["actor"], "int8")
+        n_q8 = 0
+        for leaf in jax.tree.leaves(out, is_leaf=is_q8_leaf):
+            if is_q8_leaf(leaf):
+                assert leaf["q8"].dtype == jnp.int8
+                assert leaf["q8"].ndim >= 2
+                n_q8 += 1
+            else:
+                # biases and scalars stay full precision
+                assert leaf.ndim < 2 and leaf.dtype == jnp.float32
+        assert n_q8 >= 4  # torso, x_proj, recurrent, heads
+
+    def test_idempotent(self):
+        _, params = _params(small_config())
+        for mode in QUANT_MODES:
+            once = quantize_tree(params["actor"], mode)
+            twice = quantize_tree(once, mode)
+            for a, b in zip(
+                jax.tree.leaves(once, is_leaf=is_q8_leaf),
+                jax.tree.leaves(twice, is_leaf=is_q8_leaf),
+                strict=True,
+            ):
+                if is_q8_leaf(a):
+                    np.testing.assert_array_equal(
+                        np.asarray(a["q8"]), np.asarray(b["q8"])
+                    )
+                else:
+                    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dequantize_roundtrip_error_bounded(self):
+        _, params = _params(small_config())
+        q = quantize_tree(params["actor"], "int8")
+        deq = dequantize_tree(q)
+        for a, b in zip(
+            jax.tree.leaves(params["actor"]), jax.tree.leaves(deq),
+            strict=True,
+        ):
+            a, b = np.asarray(a), np.asarray(b)
+            assert b.dtype == np.float32
+            # per-tensor symmetric: error <= scale/2 = max|w|/254 per entry
+            bound = max(np.abs(a).max() / 254.0, 1e-7)
+            assert np.abs(a - b).max() <= bound + 1e-7
+
+    def test_bytes_shrink_with_mode(self):
+        _, params = _params(small_config())
+        f32 = tree_bytes(quantize_tree(params["actor"], "f32"))
+        bf16 = tree_bytes(quantize_tree(params["actor"], "bf16"))
+        int8 = tree_bytes(quantize_tree(params["actor"], "int8"))
+        assert int8 < bf16 < f32
+
+    def test_quant_spec_paths(self):
+        _, params = _params(small_config())
+        spec = quant_spec(quantize_tree(params["actor"], "int8"))
+        assert spec, "spec map empty"
+        assert any("kernel" in k for k in spec)
+        dtypes = {dtype for dtype, _shape in spec.values()}
+        assert dtypes == {"int8", "float32"}, dtypes
+        # every q8 row keeps its pre-quantization matrix shape
+        assert all(
+            len(shape) >= 2
+            for dtype, shape in spec.values() if dtype == "int8"
+        )
+
+
+# ---------------------------------------------------------- policy parity
+def _margin_params(cfg, family, scale=4.0, seed=0):
+    """Init params with the logits head scaled up: random-init logits are
+    near-uniform, where ANY noise flips the argmax — scaling the head
+    recreates the decisive margins a trained policy has, which is the
+    regime the >=99% agreement bar is specified against."""
+    params = family.init_params(jax.random.key(seed), seq_len=cfg.seq_len)
+    actor = jax.tree_util.tree_map(lambda x: x, params["actor"])  # copy
+    head = actor["params"]["logits"]
+    head["kernel"] = head["kernel"] * scale
+    return actor
+
+
+class TestQuantParity:
+    ROWS = 512
+
+    def _act(self, cfg, family, actor_params, mode):
+        obs = np.asarray(
+            jax.random.normal(
+                jax.random.key(7), (self.ROWS, int(cfg.obs_shape[0]))
+            )
+        )
+        hw, cw = family.carry_widths
+        h = jnp.zeros((self.ROWS, hw))
+        c = jnp.zeros((self.ROWS, cw))
+        served = dequantize_tree(quantize_tree(actor_params, mode))
+        return family.act(
+            {"actor": served}, jnp.asarray(obs), h, c, jax.random.key(3)
+        )
+
+    def test_discrete_argmax_agreement_and_logp_drift(self):
+        cfg = small_config(hidden_size=32)
+        family = build_family(cfg)
+        actor = _margin_params(cfg, family)
+        _, logits_f32, lp_f32, _, _ = self._act(cfg, family, actor, "f32")
+        for mode, atol in (("bf16", 0.05), ("int8", 0.08)):
+            a_q, logits_q, lp_q, _, _ = self._act(cfg, family, actor, mode)
+            agree = float(
+                np.mean(
+                    np.argmax(np.asarray(logits_q), -1)
+                    == np.argmax(np.asarray(logits_f32), -1)
+                )
+            )
+            assert agree >= 0.99, (mode, agree)
+            drift = float(
+                np.abs(np.asarray(lp_q) - np.asarray(lp_f32)).mean()
+            )
+            assert drift <= atol, (mode, drift)
+
+    def test_discrete_same_key_same_actions_bf16(self):
+        cfg = small_config(hidden_size=32)
+        family = build_family(cfg)
+        actor = _margin_params(cfg, family)
+        a_f32, *_ = self._act(cfg, family, actor, "f32")
+        a_bf16, *_ = self._act(cfg, family, actor, "bf16")
+        same = float(np.mean(np.asarray(a_f32) == np.asarray(a_bf16)))
+        assert same >= 0.99, same
+
+    def test_continuous_mean_parity(self):
+        cfg = small_config(
+            algo="PPO-Continuous", is_continuous=True, hidden_size=32
+        )
+        family = build_family(cfg)
+        actor = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)[
+            "actor"
+        ]
+        acts = {}
+        for mode in QUANT_MODES:
+            a, _, lp, _, _ = self._act(cfg, family, actor, mode)
+            acts[mode] = np.asarray(a)
+        # same PRNG key: sampled actions track the quantization error of mu
+        np.testing.assert_allclose(
+            acts["bf16"], acts["f32"], atol=5e-2
+        )
+        np.testing.assert_allclose(
+            acts["int8"], acts["f32"], atol=1e-1
+        )
+        np.testing.assert_allclose(
+            acts["bf16"].mean(0), acts["f32"].mean(0), atol=2e-2
+        )

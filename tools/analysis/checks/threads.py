@@ -49,8 +49,13 @@ INVENTORY: dict[str, dict[str, frozenset[str]]] = {
         # perf: GIL-atomic reference store at thread start (None until the
         # PerfTracker exists); the learner's telemetry emit only reads it,
         # and a pre-capture sighting just exports zero FLOPs for one tick.
+        # buckets: the resolved bucket ladder, stored once before warmup
+        # (GIL-atomic list reference, never mutated after); telemetry emits
+        # read it to label per-bucket counters, and a pre-store sighting
+        # sees the empty placeholder — zero rows for one tick, not a race.
         "InferenceService._serve": frozenset(
-            {"_jnp", "error", "n_flush_full", "n_flush_deadline", "perf"}
+            {"_jnp", "error", "n_flush_full", "n_flush_deadline", "perf",
+             "buckets"}
         ),
     },
     "tpu_rl/obs/exporters.py": {

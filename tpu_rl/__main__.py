@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="env-batch size for colocated mode (overrides "
                    "batch_size there; 0/unset = batch_size)")
     p.add_argument("--mesh-data", type=int, help="learner data-mesh size")
+    p.add_argument("--act-mode", choices=["local", "remote"], default=None,
+                   help="'remote' routes worker acting through the "
+                   "centralized inference service/fleet (SEED-style); "
+                   "'local' acts on worker host cores (default: local)")
     p.add_argument("--inference-replicas", type=int, default=None,
                    help="inference fleet size for act_mode=remote: replica 0 "
                    "serves in-process in the learner, replicas 1..N-1 are "
@@ -59,6 +63,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--inference-mesh-data", type=int, default=None,
                    help="GSPMD data-mesh size each inference replica shards "
                    "its act batch over (1/unset = single-device)")
+    p.add_argument("--inference-dtype", choices=["f32", "bf16", "int8"],
+                   default=None,
+                   help="serving-param precision: bf16 halves / int8 "
+                   "quarters the resident actor tree; the jitted act step "
+                   "dequantizes, so compute stays f32 (default: f32)")
+    p.add_argument("--inference-buckets", type=int, default=None,
+                   help="smallest bucket of the power-of-two flush-shape "
+                   "ladder, all compiled before the socket binds; 0/unset = "
+                   "one padded program (the bit-for-bit legacy path)")
+    p.add_argument("--act-kernel", choices=["xla", "pallas"], default=None,
+                   help="'pallas' fuses the act step into one VMEM-resident "
+                   "TPU kernel where it fits, falling back to XLA elsewhere "
+                   "(default: xla)")
     p.add_argument("--max-updates", type=int, default=None)
     p.add_argument("--publish-interval", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
@@ -143,6 +160,8 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["colocated_envs"] = args.colocated_envs
     if args.mesh_data:
         overrides["mesh_data"] = args.mesh_data
+    if args.act_mode is not None:
+        overrides["act_mode"] = args.act_mode
     if args.inference_replicas is not None:
         overrides["inference_replicas"] = args.inference_replicas
     if args.inference_base_port is not None:
@@ -151,6 +170,12 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["inference_hedge_ms"] = args.inference_hedge_ms
     if args.inference_mesh_data is not None:
         overrides["inference_mesh_data"] = args.inference_mesh_data
+    if args.inference_dtype is not None:
+        overrides["inference_dtype"] = args.inference_dtype
+    if args.inference_buckets is not None:
+        overrides["inference_buckets"] = args.inference_buckets
+    if args.act_kernel is not None:
+        overrides["act_kernel"] = args.act_kernel
     if args.telemetry_port is not None:
         overrides["telemetry_port"] = args.telemetry_port
     if args.trace_sample_n is not None:

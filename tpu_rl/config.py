@@ -376,6 +376,27 @@ class Config:
     # axis, params replicated) and the padded act program runs under GSPMD.
     # 1 = single-device (no sharding constraints applied).
     inference_mesh_data: int = 1
+    # ---- serving fast path (quantized params + bucketed batching) ----
+    # Serving precision for the actor params held by InferenceService /
+    # InferenceReplica: params are cast ONCE at set_params time
+    # (tpu_rl.models.quant) and dequantized inside the jitted act step.
+    # "f32" = bit-for-bit baseline; "bf16" halves the param bytes each
+    # flush moves; "int8" quarters the matmul-weight bytes (per-tensor
+    # symmetric scales, biases stay f32). Training precision is untouched.
+    inference_dtype: str = "f32"
+    # Padded-batch bucket ladder: 0 = single fixed pad_rows =
+    # max(inference_batch, worker_num_envs) (legacy behavior, the A/B
+    # baseline). > 0 = power-of-two buckets from this floor up to pad_rows
+    # (e.g. 8 -> [8, 16, 32, ..., pad_rows]); each flush dispatches the
+    # smallest covering bucket's pre-warmed program, so small flushes stop
+    # paying the full padded step. All buckets compile before the socket
+    # binds: the recompile ratchet (inference-xla-recompiles) stays 0.
+    inference_buckets: int = 0
+    # Act-step kernel for the serving/local act path: "xla" = the generic
+    # family.act; "pallas" = the fused torso->LSTM->head kernel
+    # (tpu_rl.ops.pallas_act) where supported (discrete LSTM actor-critic,
+    # f32 compute, single-device), transparent fallback elsewhere.
+    act_kernel: str = "xla"
     # ---- supervision (tpu_rl.runtime.runner.Supervisor) ----
     # A child silent (no heartbeat) for `heartbeat_timeout_s` is killed and
     # respawned; `startup_grace_s` extends the allowance after (re)spawn so
@@ -649,6 +670,11 @@ class Config:
             f"request timeout ({self.inference_timeout_ms} ms) can never fire"
         )
         assert self.inference_mesh_data >= 1, self.inference_mesh_data
+        assert self.inference_dtype in ("f32", "bf16", "int8"), (
+            self.inference_dtype
+        )
+        assert self.inference_buckets >= 0, self.inference_buckets
+        assert self.act_kernel in ("xla", "pallas"), self.act_kernel
         if self.inference_base_port:
             # Explicit replica port range: must fit the port space and must
             # not collide with the telemetry HTTP port (learner/model/worker

@@ -57,25 +57,33 @@ class InferenceReplica(InferenceService):
         Re-delivered broadcasts (idle rebroadcast, join push) and reordered
         frames become no-ops instead of rollbacks, so every reply's ``ver``
         is monotonic per replica — the server half of the fleet's
-        version-floor guarantee."""
+        version-floor guarantee. Quantization to the serving dtype runs
+        OUTSIDE the lock (it launches device work); the ver gate is checked
+        before (skip the cast for frames already known stale) and again
+        under the lock (a newer frame may have landed meanwhile)."""
         with self._lock:
             if version <= self._version:
                 self.n_stale_sets += 1
                 return
-            self._params = params
+        quant = self._quantize(params)
+        with self._lock:
+            if version <= self._version:
+                self.n_stale_sets += 1
+                return
+            self._params = quant
             self._version = version
 
     # ---------------------------------------------------------------- GSPMD
     def _build_step(self, jax, jnp):
         """Jit the act program under the named data mesh when
         ``inference_mesh_data > 1``; single-device replicas keep the base
-        jit. ``pad_rows`` is rounded UP to a mesh-divisible count so the
-        fixed padded shape shards evenly."""
+        bucketed jits. Every bucket shape is rounded UP to a mesh-divisible
+        count (then deduped) so each padded program shards evenly — the
+        quantized param tree stays replicated leaf-wise exactly like f32."""
         cfg = self.cfg
         n = int(getattr(cfg, "inference_mesh_data", 1))
-        pad_rows = max(cfg.inference_batch, cfg.worker_num_envs)
         if n <= 1:
-            return jax.jit(self._step_fn(jnp)), pad_rows
+            return super()._build_step(jax, jnp)
         from tpu_rl.parallel.mesh import (
             batch_sharding,
             check_divisible,
@@ -84,26 +92,33 @@ class InferenceReplica(InferenceService):
         )
 
         mesh = make_mesh(n)
-        pad_rows = -(-pad_rows // n) * n  # ceil to a shardable batch
-        check_divisible(pad_rows, mesh)
+        # ceil each bucket to a shardable batch; dedupe collisions
+        buckets = sorted({-(-b // n) * n for b in self._bucket_ladder()})
+        check_divisible(buckets[-1], mesh)
         rep, bsh = replicated(mesh), batch_sharding(mesh)
-        step = jax.jit(
-            self._step_fn(jnp),
-            # Params replicated, batch-shaped operands split on "data",
-            # PRNG key replicated; outputs inherit GSPMD's propagation.
-            in_shardings=(rep, bsh, bsh, bsh, bsh, rep),
-        )
-        return step, pad_rows
+        steps = {
+            rows: jax.jit(
+                self._step_fn(jnp),
+                # Params replicated, batch-shaped operands split on "data",
+                # PRNG key replicated; outputs inherit GSPMD's propagation.
+                in_shardings=(rep, bsh, bsh, bsh, bsh, rep),
+            )
+            for rows in buckets
+        }
+        return steps, buckets
 
     # --------------------------------------------------- continuous batching
-    def _loop(self, jax, router, step, pad_rows, key) -> None:
+    def _loop(self, jax, router, steps, buckets, key) -> None:
         """Admit-and-dispatch: no max-batch gate, no deadline. Whatever is
         pending when the device is free forms the batch (bounded by the
-        padded program shape); requests arriving during a dispatch join the
-        next one. The base counters stay honest: a dispatch at the padded
+        largest bucket program) and dispatches through the smallest covering
+        bucket. The base counters stay honest: a dispatch at the padded
         capacity counts as ``n_flush_full``, everything else as a
         continuous admission."""
+        from bisect import bisect_left
+
         jnp = self._jnp
+        pad_rows = buckets[-1]
         store_carry = self.family.store_carry
         pending = []
         pending_rows = 0
@@ -153,10 +168,12 @@ class InferenceReplica(InferenceService):
                 self.n_flush_full += 1
             else:
                 self.n_flush_continuous += 1
+            bucket = buckets[bisect_left(buckets, rows)]
             key, sub = jax.random.split(key)
             t_fl = time.perf_counter()
             self._flush(
-                router, step, chunk, rows, pad_rows, sub, store_carry, jnp
+                router, steps[bucket], chunk, rows, bucket, sub,
+                store_carry, jnp,
             )
             if ledger is not None:
                 ledger.add(COMPUTE, time.perf_counter() - t_fl)
@@ -240,9 +257,9 @@ def replica_main(
                         registry.gauge("inference-achieved-flops").set(
                             achieved
                         )
-                    registry.counter("inference-xla-recompiles").set_total(
-                        svc.perf.recompiles
-                    )
+                # Fast-path observables: summed per-bucket recompile watch,
+                # param footprint, bucket dispatch histogram + counters.
+                svc.publish_serving_metrics(registry)
                 if svc.ledger is not None:
                     svc.ledger.publish(registry)
                 emitter.maybe_emit()

@@ -324,6 +324,7 @@ def run_loadgen(
     rows: int = 1,
     obs_dim: int | None = None,
     slo_spec: str | None = None,
+    extra_snapshots=None,
 ) -> dict:
     """Sweep ``rates`` (aggregate offered rps) across ``n_procs`` driver
     processes and produce the saturation-curve document.
@@ -333,8 +334,14 @@ def run_loadgen(
     from the merged histogram, and — when ``slo_spec`` is given — a FRESH
     SLO engine grades the merged snapshot, so every stage's verdict is
     independent (a saturated stage must not burn the budget of the
-    sub-saturation stage before it). Writes ``out_path`` (loadgen.json)
-    when given; returns the document either way.
+    sub-saturation stage before it). ``extra_snapshots`` (optional
+    zero-arg callable -> list of snapshot dicts) joins SERVER-side
+    telemetry to each stage's grading set — e.g. the replicas' live stat
+    snapshots, so rules over server counters
+    (``counter:inference-xla-recompiles==0``) grade against real fleet
+    state, not just the drivers' client view; it is called once per stage
+    at grading time. Writes ``out_path`` (loadgen.json) when given;
+    returns the document either way.
     """
     from tpu_rl.obs.slo import SloEngine
 
@@ -409,7 +416,10 @@ def run_loadgen(
             **quant,
         }
         if slo_spec:
-            stage["slo"] = SloEngine(slo_spec).evaluate([snap])
+            graded = [snap]
+            if extra_snapshots is not None:
+                graded = graded + list(extra_snapshots())
+            stage["slo"] = SloEngine(slo_spec).evaluate(graded)
         stages.append(stage)
 
     doc = {

@@ -134,6 +134,18 @@ class Histogram:
             self.sum += v
             self.count += 1
 
+    def observe_n(self, value: float, n: int) -> None:
+        """Bulk-observe ``n`` identical samples — delta replay of an
+        externally-counted event stream (e.g. the inference service's
+        per-bucket flush counts) without n lock round-trips."""
+        if n <= 0:
+            return
+        v = float(value)
+        with self._lock:
+            self.counts[bisect_left(HIST_BUCKETS, v)] += n
+            self.sum += v * n
+            self.count += n
+
     def quantile(self, q: float) -> float | None:
         """p50/p90/p99/p999 estimate (see :func:`hist_quantile`)."""
         with self._lock:

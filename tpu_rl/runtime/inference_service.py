@@ -15,8 +15,20 @@ Design:
   episode-first flags — the recurrent carry does NOT ride the request);
 - requests accumulate until ``Config.inference_batch`` observation rows are
   pending or the oldest request is ``Config.inference_flush_us`` old, then
-  ONE jitted ``family.act`` runs over fixed padded batch slots on the
-  learner's device (fixed shape = exactly one XLA compile);
+  ONE jitted act step runs over padded batch slots on the learner's device.
+  Padding comes from a power-of-two **bucket ladder**
+  (``Config.inference_buckets``): each flush dispatches the smallest
+  pre-warmed bucket program covering its rows, so small flushes stop paying
+  the full padded step; every bucket compiles before the socket binds, so
+  the recompile ratchet (``inference-xla-recompiles``) stays at zero.
+  ``inference_buckets = 0`` keeps the single fixed
+  ``pad_rows = max(inference_batch, worker_num_envs)`` shape bit-for-bit
+  (the A/B baseline);
+- the **serving fast path** (tpu_rl.models.quant) composes here: params are
+  cast to ``Config.inference_dtype`` once at ``set_params`` time and
+  dequantized inside the jitted step (fewer HBM bytes per flush), and
+  ``Config.act_kernel = "pallas"`` swaps the act computation for the fused
+  torso->LSTM->head kernel (tpu_rl.ops.pallas_act) where supported;
 - the recurrent carry (h/c) lives server-side per worker-env slot, zeroed
   where the request flags an episode first — workers never maintain or ship
   acting state. For ``store_carry`` families (LSTM) the *reply* carries the
@@ -46,8 +58,9 @@ from tpu_rl.utils.timer import ExecutionTimer
 
 
 class _ClientState:
-    """Per-DEALER-identity acting state: the env-slot carries (device
-    arrays) and the row count the client established on first contact."""
+    """Per-DEALER-identity acting state: the env-slot carries (HOST numpy
+    rows — see ``_flush``) and the row count the client established on
+    first contact."""
 
     __slots__ = ("n", "h", "c")
 
@@ -112,11 +125,28 @@ class InferenceService:
         self.n_flush_full = 0
         self.n_flush_deadline = 0
         self.n_rejected_payload = 0
+        # Per-bucket flush counts {bucket_rows: n} — the serving fast path's
+        # dispatch histogram source (emitters replay deltas into the
+        # inference-bucket-rows registry histogram).
+        self.n_flush_bucket: dict[int, int] = {}
         self.error: BaseException | None = None
         # Live perf accounting for the act step (tpu_rl.obs.perf): FLOPs
         # per flushed batch + recompile watch. Built by the serve thread iff
         # telemetry is on; the learner's _emit_telemetry reads it.
+        # One tracker per bucket program (each bucket is its own jit, so
+        # each _JitWatch sees exactly its one expected compile); ``perf``
+        # stays the largest bucket's tracker — the shape whose FLOPs defines
+        # the headline MFU, and the only tracker in the single-bucket
+        # baseline.
         self.perf = None
+        self.perf_buckets: dict[int, object] = {}
+        # Bucket ladder actually compiled (set by the serve thread) and the
+        # served param-tree footprint (inference-param-bytes gauge).
+        self.buckets: list[int] = []
+        self.param_bytes = 0
+        # Per-bucket flush counts already replayed into the registry
+        # histogram (publish_serving_metrics delta bookkeeping).
+        self._hist_emitted: dict[int, int] = {}
         # Goodput ledger for the SERVE thread (tpu_rl.obs.goodput), built in
         # _warm iff telemetry is on. Its own thread-lane: inference wait /
         # flush time must not double into the owning learner's ledger.
@@ -147,10 +177,36 @@ class InferenceService:
         (first-request latency then excludes the XLA compile)."""
         return self._ready.wait(timeout)
 
+    def _quantize(self, params):
+        """Cast to the serving precision (``Config.inference_dtype``) —
+        idempotent, so re-applied frames never double-scale. EVERY mode then
+        commits the tree to the default device: the bucket jits have no
+        in_shardings, so their cache keys on the param placement, and swap
+        sources disagree about it — wire-decoded HOST trees (fleet replicas
+        off the model broadcast) vs the learner's in-process trees carrying
+        the train step's NamedSharding. Either one, unpinned, lands in a
+        fresh jit cache entry vs the warmup trace — a real executable build
+        on the serve path and a false positive on the recompile ratchet.
+        (The GSPMD replica path is placement-insensitive — its jits pin
+        explicit in_shardings — so the committed copy is just as correct
+        there.) Boot params pass through this same gate at serve start, so
+        warmup and swaps agree by construction."""
+        import jax
+
+        mode = getattr(self.cfg, "inference_dtype", "f32")
+        if mode != "f32":
+            from tpu_rl.models.quant import quantize_tree
+
+            params = quantize_tree(params, mode)
+        return jax.device_put(params, jax.devices()[0])
+
     def set_params(self, params, version: int = -1) -> None:
-        """In-process param swap from the learner — a reference assignment
-        of the device pytree, no copy, no wire. The NEXT flushed batch acts
-        with the new weights, and replies echo the new ``version``."""
+        """In-process param swap from the learner — quantize to the serving
+        dtype OUTSIDE the lock, then one reference assignment of the device
+        pytree (the swap itself stays atomic and copy-free). The NEXT
+        flushed batch acts with the new weights, and replies echo the new
+        ``version``."""
+        params = self._quantize(params)
         with self._lock:
             self._params = params
             self._version = version
@@ -177,14 +233,19 @@ class InferenceService:
         import jax.numpy as jnp
 
         self._jnp = jnp
-        step, pad_rows = self._build_step(jax, jnp)
+        # Boot params enter through the same quantization gate as swaps
+        # (idempotent, so a set_params that already ran is a no-op cast).
+        with self._lock:
+            self._params = self._quantize(self._params)
+        steps, buckets = self._build_step(jax, jnp)
+        self.buckets = list(buckets)
         router = None
         try:
-            self._warm(jax, jnp, step, pad_rows)
+            self._warm(jax, jnp, steps, buckets)
             router = Router(*self.addr, bind=True)
             key = jax.random.key(self.seed * 7919 + 17)
             self._ready.set()
-            self._loop(jax, router, step, pad_rows, key)
+            self._loop(jax, router, steps, buckets, key)
         except BaseException as e:  # noqa: BLE001 — surfaced via .error
             self.error = e
             self._ready.set()  # never leave wait_ready() hanging
@@ -194,10 +255,16 @@ class InferenceService:
                 router.close()
 
     def _step_fn(self, jnp):
-        """The pure padded act program (shared by every jit variant)."""
-        act = self.family.act
+        """The pure padded act program (shared by every jit variant).
+        Serving-dtype params are dequantized INSIDE the program (the
+        compiled step reads the narrow bytes from HBM and widens on chip);
+        the act computation itself is the ``Config.act_kernel`` dispatch."""
+        from tpu_rl.models.quant import dequantize_tree, make_act_fn
+
+        act = make_act_fn(self.cfg, self.family)
 
         def _step(params, obs, h, c, first, key):
+            params = dequantize_tree(params)
             # Zero the carry rows whose env just reset (server-side episode
             # seam — the request's `first` flag is the only state the worker
             # contributes). The zeroed PRE-step carry is what local workers
@@ -211,51 +278,125 @@ class InferenceService:
 
         return _step
 
-    def _build_step(self, jax, jnp):
-        """Jit the padded act program; -> (step, pad_rows). Overridden by
-        the fleet replica (tpu_rl.fleet) to apply GSPMD batch sharding and
-        mesh-divisible padding."""
+    def _bucket_ladder(self) -> list[int]:
+        """Padded-batch shapes to pre-compile, ascending. ``inference_buckets
+        = 0`` (default) reproduces the legacy single fixed shape
+        ``max(inference_batch, worker_num_envs)`` bit-for-bit; > 0 is the
+        power-of-two ladder from that floor up to pad_rows, so a flush of r
+        rows dispatches the smallest covering program instead of always
+        paying the largest."""
         cfg = self.cfg
         pad_rows = max(cfg.inference_batch, cfg.worker_num_envs)
-        return jax.jit(self._step_fn(jnp)), pad_rows
+        floor = int(getattr(cfg, "inference_buckets", 0))
+        if floor <= 0 or floor >= pad_rows:
+            return [pad_rows]
+        b = 1
+        while b < floor:
+            b *= 2
+        ladder = []
+        while b < pad_rows:
+            ladder.append(b)
+            b *= 2
+        ladder.append(pad_rows)
+        return ladder
 
-    def _warm(self, jax, jnp, step, pad_rows) -> None:
-        """Compile at the padded shape BEFORE binding the socket: the first
-        real request must never eat the XLA compile inside the workers'
-        inference_timeout_ms window."""
+    def _build_step(self, jax, jnp):
+        """Jit the padded act program, once per bucket shape; ->
+        (steps: {bucket_rows: jitted step}, buckets ascending). Each bucket
+        is a SEPARATE ``jax.jit`` (fresh closure) so every program carries
+        its own dispatch cache — the per-bucket PerfTracker's recompile
+        watch then expects exactly one compile each. Overridden by the fleet
+        replica (tpu_rl.fleet) to apply GSPMD batch sharding and
+        mesh-divisible bucket rounding."""
+        buckets = self._bucket_ladder()
+        steps = {rows: jax.jit(self._step_fn(jnp)) for rows in buckets}
+        return steps, buckets
+
+    def _warm(self, jax, jnp, steps, buckets) -> None:
+        """Compile EVERY bucket shape BEFORE binding the socket: the first
+        real request must never eat an XLA compile inside the workers'
+        inference_timeout_ms window, at any flush size."""
         hw, cw = self.family.carry_widths
         obs_dim = int(self.cfg.obs_shape[0])
-        zeros = (
-            jnp.zeros((pad_rows, obs_dim)),
-            jnp.zeros((pad_rows, hw)),
-            jnp.zeros((pad_rows, cw)),
-            jnp.zeros((pad_rows,)),
-        )
         with self._lock:
             params = self._params
-        if getattr(self.cfg, "telemetry_enabled", False):
+        telemetry = getattr(self.cfg, "telemetry_enabled", False)
+        if telemetry:
             from tpu_rl.obs.goodput import GoodputLedger
-            from tpu_rl.obs.perf import PerfTracker
+            from tpu_rl.models.quant import tree_bytes
 
-            self.perf = PerfTracker()
             self.ledger = GoodputLedger("inference")
-            # One-time cost analysis at the padded warmup shape — the
-            # only shape the service ever dispatches, so a later cache
-            # miss is a real drift signal (inference-xla-recompiles).
-            self.perf.capture(
-                step, params, *zeros, jax.random.key(self.seed)
+            self.param_bytes = tree_bytes(params)
+        for rows in buckets:
+            step = steps[rows]
+            # HOST zeros, matching the arg kinds `_flush` passes at runtime
+            # (numpy staging buffers): host and device operands land in
+            # DIFFERENT jit cache entries even at identical avals, so
+            # warming with device arrays would make the first real flush
+            # count as a recompile.
+            zeros = (
+                np.zeros((rows, obs_dim), np.float32),
+                np.zeros((rows, hw), np.float32),
+                np.zeros((rows, cw), np.float32),
+                np.zeros((rows,), np.float32),
             )
-        jax.block_until_ready(
-            step(params, *zeros, jax.random.key(self.seed))
-        )
+            if telemetry:
+                from tpu_rl.obs.perf import PerfTracker
 
-    def _loop(self, jax, router, step, pad_rows, key) -> None:
+                tracker = PerfTracker()
+                # One-time cost analysis at this bucket's padded shape —
+                # the only shape its program ever dispatches, so a later
+                # cache miss is a real drift signal
+                # (inference-xla-recompiles sums the per-bucket watches).
+                tracker.capture(
+                    step, params, *zeros, jax.random.key(self.seed)
+                )
+                self.perf_buckets[rows] = tracker
+            jax.block_until_ready(
+                step(params, *zeros, jax.random.key(self.seed))
+            )
+        if telemetry:
+            self.perf = self.perf_buckets[buckets[-1]]
+
+    @property
+    def recompiles(self) -> int:
+        """Act-program recompiles after warmup, summed over every bucket
+        program — the PR 11 ratchet (and the loadgen smoke's
+        ``counter:inference-xla-recompiles==0`` SLO source). 0 when
+        telemetry is off (no watches installed)."""
+        return sum(t.recompiles for t in self.perf_buckets.values())
+
+    def publish_serving_metrics(self, registry) -> None:
+        """Replay the serving fast-path observables into a MetricsRegistry —
+        called by whoever owns the registry (the learner's telemetry emit or
+        ``fleet.replica_main``). Cumulative counters use set_total; the
+        bucket histogram replays per-bucket flush-count DELTAS so repeated
+        calls never double-observe."""
+        registry.counter("inference-xla-recompiles").set_total(
+            self.recompiles
+        )
+        registry.gauge("inference-param-bytes").set(self.param_bytes)
+        hist = registry.histogram("inference-bucket-rows")
+        for rows, n in list(self.n_flush_bucket.items()):
+            registry.counter(
+                "inference-bucket-flushes", labels={"rows": str(rows)}
+            ).set_total(n)
+            prev = self._hist_emitted.get(rows, 0)
+            if n > prev:
+                hist.observe_n(rows, n - prev)
+                self._hist_emitted[rows] = n
+
+    def _loop(self, jax, router, steps, buckets, key) -> None:
         """Max-batch-or-deadline dynamic batching (the PR 2 semantics): a
         flush dispatches when ``inference_batch`` rows are pending or the
-        oldest request is ``inference_flush_us`` old. The fleet replica
-        overrides this with continuous batching."""
+        oldest request is ``inference_flush_us`` old — into the smallest
+        covering bucket program. The fleet replica overrides this with
+        continuous batching."""
+        from bisect import bisect_left
+
         cfg = self.cfg
         jnp = self._jnp
+        pad_rows = buckets[-1]  # chunk capacity = the largest program
         store_carry = self.family.store_carry
         pending: list[_Pending] = []
         pending_rows = 0
@@ -315,10 +456,11 @@ class InferenceService:
                     chunk.append(req)
                     rows += req.obs.shape[0]
                 pending_rows -= rows
+                bucket = buckets[bisect_left(buckets, rows)]
                 key, sub = jax.random.split(key)
                 t_fl = time.perf_counter()
                 self._flush(
-                    router, step, chunk, rows, pad_rows, sub,
+                    router, steps[bucket], chunk, rows, bucket, sub,
                     store_carry, jnp,
                 )
                 if ledger is not None:
@@ -349,11 +491,12 @@ class InferenceService:
         self.n_requests += 1
         client = self.clients.get(identity)
         if client is None or client.n != obs.shape[0]:
-            jnp = self._jnp
             hw, cw = self.family.carry_widths
             n = obs.shape[0]
             client = _ClientState(
-                n, jnp.zeros((n, hw)), jnp.zeros((n, cw))
+                n,
+                np.zeros((n, hw), np.float32),
+                np.zeros((n, cw), np.float32),
             )
             self.clients[identity] = client
         return _Pending(identity, seq, obs, first, time.perf_counter())
@@ -364,43 +507,48 @@ class InferenceService:
         if self.chaos is not None:
             self.chaos.maybe_stall()
         t0 = time.perf_counter()
+        # Shape-stable staging: obs/first/h/c are built as HOST buffers at
+        # exactly the bucket's padded shape, so the ONLY device programs a
+        # flush ever runs are the pre-warmed bucket jits. Gathering carries
+        # with jnp.concatenate over per-client device slices would compile
+        # a fresh concat executable for every novel chunk composition
+        # (20ms+ each, unbounded combos under open-loop load) — a hidden
+        # recompile the bucket ratchet exists to forbid.
         obs = np.zeros((pad_rows, chunk[0].obs.shape[1]), np.float32)
         first = np.ones((pad_rows,), np.float32)  # pad slots: reset carry
+        hw, cw = self.family.carry_widths
+        h = np.zeros((pad_rows, hw), np.float32)
+        c = np.zeros((pad_rows, cw), np.float32)
         off = 0
         offsets = []
         for req in chunk:
             n = req.obs.shape[0]
             obs[off:off + n] = req.obs
             first[off:off + n] = req.first
+            client = self.clients[req.identity]
+            h[off:off + n] = client.h
+            c[off:off + n] = client.c
             offsets.append(off)
             off += n
-        hw, cw = self.family.carry_widths
-        h_parts = [self.clients[r.identity].h for r in chunk]
-        c_parts = [self.clients[r.identity].c for r in chunk]
-        if rows < pad_rows:
-            h_parts.append(jnp.zeros((pad_rows - rows, hw)))
-            c_parts.append(jnp.zeros((pad_rows - rows, cw)))
-        h = jnp.concatenate(h_parts)
-        c = jnp.concatenate(c_parts)
         with self._lock:
             params = self._params
             version = self._version
         a, logits, log_prob, h_pre, c_pre, h2, c2 = step(
-            params, jnp.asarray(obs), h, c, jnp.asarray(first), key
+            params, obs, h, c, first, key
         )
         # One host transfer for the whole batch; per-client row slices view it.
         a_np = np.asarray(a)
         logits_np = np.asarray(logits)
         lp_np = np.asarray(log_prob)
+        h2_np = np.asarray(h2)
+        c2_np = np.asarray(c2)
         h_pre_np = np.asarray(h_pre) if store_carry else None
         c_pre_np = np.asarray(c_pre) if store_carry else None
         for req, off in zip(chunk, offsets, strict=True):
             n = req.obs.shape[0]
             client = self.clients[req.identity]
-            # lax.dynamic_slice-free row updates: device-side slicing keeps
-            # the carries as device arrays between ticks.
-            client.h = h2[off:off + n]
-            client.c = c2[off:off + n]
+            client.h = h2_np[off:off + n]
+            client.c = c2_np[off:off + n]
             reply = {
                 "seq": req.seq,
                 "act": a_np[off:off + n],
@@ -423,10 +571,16 @@ class InferenceService:
             self.n_replies += 1
         self.n_batches += 1
         self.timer.record_gauge("inference-batch-size", rows)
+        # ``pad_rows`` here is the dispatched bucket's padded shape: the
+        # per-bucket flush count feeds the inference-bucket-rows histogram
+        # (emitters replay the deltas) and the per-bucket FLOPs tracker
+        # keeps MFU honest at every shape.
+        self.n_flush_bucket[pad_rows] = self.n_flush_bucket.get(pad_rows, 0) + 1
         flush_secs = time.perf_counter() - t0
         self.timer.record("inference-step-time", flush_secs)
-        if self.perf is not None:
-            self.perf.note(flush_secs)
+        tracker = self.perf_buckets.get(pad_rows)
+        if tracker is not None:
+            tracker.note(flush_secs)
 
 
 class InferenceClient:

@@ -1189,9 +1189,9 @@ class LearnerService:
                 achieved = svc.perf.achieved_flops_per_s()
                 if achieved is not None:
                     reg.gauge("inference-achieved-flops").set(achieved)
-                reg.counter("inference-xla-recompiles").set_total(
-                    svc.perf.recompiles
-                )
+            # Fast-path observables: summed per-bucket recompile watch,
+            # param footprint, bucket dispatch histogram + counters.
+            svc.publish_serving_metrics(reg)
         snap = reg.snapshot()
         # Top-level epoch echo (same convention as workers): storage
         # ratchets its stale-frame fence from whichever epoch source lands

@@ -248,7 +248,12 @@ class Worker:
         else:
             key, init_key = jax.random.split(key)
             params = family.init_params(init_key, seq_len=cfg.seq_len)
-        act = jax.jit(family.act)
+        # Local act path shares the serving kernel dispatch
+        # (Config.act_kernel): "pallas" fuses the act step where supported,
+        # "xla" (default) is family.act unchanged.
+        from tpu_rl.models.quant import make_act_fn
+
+        act = jax.jit(make_act_fn(cfg, family))
 
         # Remote acting (act_mode="remote"): ship obs to the learner-device
         # inference service, fall back to the local jitted path above if it
