@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
 	resume-smoke slo-smoke loadgen-smoke serving-smoke heal-smoke \
-	pbt-smoke goodput-smoke ci
+	pbt-smoke goodput-smoke autopilot-smoke ci
 
 lint:
 	ruff check .
@@ -143,6 +143,10 @@ pbt-smoke:
 goodput-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/goodput_smoke.py
 
+autopilot-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/autopilot_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
-	loadgen-smoke serving-smoke heal-smoke pbt-smoke goodput-smoke
+	loadgen-smoke serving-smoke heal-smoke pbt-smoke goodput-smoke \
+	autopilot-smoke

@@ -1,0 +1,377 @@
+"""Autopilot smoke: the closed control loop end to end, on one host — the
+CPU-scale proof of ISSUE 17's acceptance bar.
+
+One ``AutopilotController`` (``manage_all=True``: it owns the whole
+replica range) supervises an elastic inference fleet while a diurnal
+loadgen schedule sweeps offered load 20 -> 205 -> 20 rps (>= 10x up and
+back down):
+
+- every replica stalls 40ms per batch flush (``stall:inference@40ms``
+  service chaos), pinning single-replica capacity near ``batch/stall``
+  ~190 rps — so the peak stage saturates one replica deterministically
+  and the valleys never do;
+- a probe client + 1 Hz SLO engine grade ``p99:inference-rtt`` over a
+  sliding window of the probe's own RTT histogram; the controller
+  scrapes that ``/slo`` (plus ``/metrics``) off a smoke-local telemetry
+  server and must scale OUT to >= 2 replicas under the peak and back IN
+  when the valley returns;
+- ``kill:inference-1@t+5s`` stays armed until the first scaled-out
+  replica exists, then SIGKILLs it — the controller's supervision pass
+  must respawn it without burning the run;
+- the drivers start with lanes planned for the FULL capacity range, so
+  scale-out adoption happens through the lane re-probe backoff (this
+  PR's FleetClient satellite): the stage rows must show ``reprobes``;
+- acceptance: zero failed client requests overall, version floor never
+  decreases, both 20 rps valley stages grade GREEN on
+  ``p99:inference-rtt``, every ``autopilot.jsonl`` action record
+  validates against the documented schema, and the live dashboard frame
+  renders an AUTOPILOT panel.
+
+Exits nonzero on any failure — this is the ``make autopilot-smoke`` CI
+gate.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/autopilot_smoke.py \
+      [--clients 6000] [--base-port 31500]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The controller's policy: scale out on sustained p99 burn, back in when
+# the burn window is clean; bounds [1, 3] replicas. Scale-in demands a
+# much longer clean streak (25s at the 0.5s poll) than scale-out's 2s:
+# burn saturates at 0 whenever capacity is comfortable, so an impatient
+# scale-in would hunt the floor even under the peak.
+AUTOPILOT_SPEC = (
+    "scale_out:replicas?burn:inference-rtt>0.5"
+    "@sustain=4@cooldown=6s@max=3,"
+    "scale_in:replicas?burn:inference-rtt<0.02"
+    "@sustain=50@cooldown=6s@min=1,"
+    "limit=12/60s"
+)
+# The live engine the autopilot scrapes (1 Hz over the probe's sliding
+# window) and the per-stage grading rule for the loadgen document.
+LIVE_SLO = "p99:inference-rtt<500ms@window=15s"
+STAGE_SLO = "p99:inference-rtt<500ms@window=600s"
+# Diurnal ramp (aggregate rps, dwell seconds): 20 -> 205 -> 20 is >= 10x
+# up and back; the 120 shoulders stay under one replica's ~190 rps
+# capacity, the 205 peak saturates it.
+SCHEDULE = [(20, 20), (120, 12), (205, 80), (120, 12), (20, 55)]
+
+# Every `ev: action` line in autopilot.jsonl must carry exactly these
+# typed fields (ARCHITECTURE.md section Autopilot documents the schema).
+ACTION_SCHEMA = {
+    "action": str,
+    "target": str,
+    "rule": str,
+    "signal": str,
+    "value": (int, float),
+    "reason": str,
+    "step": int,
+    "from": int,
+    "to": int,
+    "replicas": int,
+    "workers": int,
+    "t": (int, float),
+}
+
+
+def validate_action(rec: dict) -> str | None:
+    """None when the record matches ACTION_SCHEMA, else the complaint."""
+    for key, typ in ACTION_SCHEMA.items():
+        if key not in rec:
+            return f"missing key {key!r}"
+        if not isinstance(rec[key], typ) or isinstance(rec[key], bool):
+            return f"key {key!r} has {type(rec[key]).__name__}"
+    if rec["action"] not in ("scale_out", "scale_in", "respawn"):
+        return f"unknown action {rec['action']!r}"
+    return None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=6_000)
+    p.add_argument("--base-port", type=int, default=31500)
+    p.add_argument("--result-dir", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    from tpu_rl.autopilot import AutopilotController
+    from tpu_rl.config import Config, MachinesConfig
+    from tpu_rl.fleet import FleetClient
+    from tpu_rl.loadgen import probe_ready, run_loadgen
+    from tpu_rl.models.families import build_family
+    from tpu_rl.obs import (
+        MetricsRegistry,
+        TelemetryAggregator,
+        TelemetryHTTPServer,
+    )
+    from tpu_rl.obs.registry import diff_snapshots
+    from tpu_rl.obs.slo import SloEngine
+    from tpu_rl.obs.top import build_frame, fetch_json
+    from tpu_rl.runtime.protocol import Protocol
+    from tpu_rl.runtime.transport import MODEL_HWM, Pub, Sub
+
+    stat_port = args.base_port + 10  # machines.learner_port: the stat SUB
+    http_port = args.base_port + 12  # smoke-local telemetry server
+    result_dir = args.result_dir or tempfile.mkdtemp(prefix="autopilot-smoke-")
+    machines = MachinesConfig(learner_ip="127.0.0.1", learner_port=stat_port)
+    cfg = Config.from_dict(dict(
+        algo="IMPALA", obs_shape=(4,), action_space=2, hidden_size=32,
+        worker_num_envs=1, act_mode="remote", learner_device="cpu",
+        inference_replicas=1, inference_base_port=args.base_port,
+        inference_batch=8, inference_flush_us=2000, inference_buckets=8,
+        # Generous timeout: the open-loop peak briefly queues multi-second
+        # waits while the scaled-out replica compiles; hedges stay a
+        # recovery tool (killed-lane failover), not a load amplifier.
+        inference_timeout_ms=15_000, inference_hedge_ms=3_000,
+        inference_retries=1,
+        # Fast lane re-probe so clients adopt a scaled-out replica within
+        # seconds of it binding.
+        inference_reprobe_s=0.5, inference_reprobe_max_s=4.0,
+        autopilot_spec=AUTOPILOT_SPEC, autopilot_poll_s=0.5,
+        autopilot_drain_s=0.3,
+        # stall: the deterministic saturation lever. kill: armed from the
+        # start, fires as soon as the first scaled-out replica exists.
+        chaos_spec="stall:inference@40ms,kill:inference-1@t+5s",
+        result_dir=result_dir, telemetry_interval_s=0.5,
+    ))
+    capacity_ports = machines.inference_ports(
+        cfg.replace(inference_replicas=3)
+    )
+    endpoints = [("127.0.0.1", prt) for prt in capacity_ports]
+    out_path = os.path.join(result_dir, "loadgen.json")
+
+    # Stand-in learner: rising-version model PUB (the replicas' ver-keyed
+    # swap + the clients' floor ratchet need live broadcasts).
+    family = build_family(cfg)
+    params = family.init_params(jax.random.key(0), seq_len=cfg.seq_len)
+    actor_host = jax.device_get(params["actor"])
+    pub = Pub("*", machines.model_port, bind=True, hwm=MODEL_HWM)
+    stop = threading.Event()
+
+    def _publish() -> None:
+        ver = 0
+        while not stop.is_set():
+            ver += 1
+            pub.send(Protocol.Model, {"actor": actor_host, "ver": ver})
+            stop.wait(2.0)
+
+    # Stat plane: replica + controller registries PUB here; the aggregator
+    # behind /metrics is what the autopilot's own scraper reads back.
+    stat_sub = Sub("*", stat_port, bind=True)
+    agg = TelemetryAggregator()
+
+    def _collect_stats() -> None:
+        while not stop.is_set():
+            for proto, snap in stat_sub.drain(max_msgs=256):
+                if proto == Protocol.Telemetry and isinstance(snap, dict):
+                    agg.ingest(snap)
+            stop.wait(0.1)
+
+    # Probe plane: a closed-loop client across the FULL planned range
+    # records real RTTs; the 1 Hz engine grades a sliding window of them
+    # (cumulative histograms would never recover after the peak).
+    probe_reg = MetricsRegistry(role="autopilot-probe")
+    rtt_hist = probe_reg.histogram("inference-rtt")
+    engine = SloEngine(LIVE_SLO)
+    # Short probe hedge: a probe that picks a lane the autopilot JUST
+    # retired must be rescued well under the 500ms live threshold —
+    # otherwise every scale-in pollutes the very burn signal that decided
+    # it and the controller flaps out/in forever.
+    probe_cl = FleetClient(
+        cfg.replace(inference_hedge_ms=300), endpoints, wid=7
+    )
+
+    def _probe() -> None:
+        obs = np.zeros((1, 4), np.float32)
+        first = np.ones((1,), np.float32)
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            got = probe_cl.act(obs, first, retries=0)
+            rtt = time.perf_counter() - t0
+            # A timed-out probe is a violation at the timeout bound, not
+            # a missing sample.
+            rtt_hist.observe(rtt if got is not None else rtt + 1.0)
+            stop.wait(0.1)
+
+    def _grade() -> None:
+        ring: deque = deque()  # (t, cumulative snapshot)
+        while not stop.is_set():
+            now = time.monotonic()
+            snap = probe_reg.snapshot()
+            ring.append((now, snap))
+            while ring and now - ring[0][0] > 16.0:
+                ring.popleft()
+            win = (
+                diff_snapshots(snap, ring[0][1]) if len(ring) > 1 else snap
+            )
+            engine.evaluate([win], now=now)
+            stop.wait(1.0)
+
+    ctrl = AutopilotController(
+        cfg, machines=machines, manage_all=True,
+        scrape_url=f"http://127.0.0.1:{http_port}", http_port=0, seed=0,
+    )
+    server = TelemetryHTTPServer(
+        agg, http_port, slo=engine.report, autopilot=ctrl.status_doc
+    )
+    result: dict = {}
+
+    def _run_ctrl() -> None:
+        result["autopilot"] = ctrl.run()
+
+    ap_live = None
+    probe_thread = None
+    frame: list = []
+    try:
+        threading.Thread(target=_publish, daemon=True).start()
+        threading.Thread(target=_collect_stats, daemon=True).start()
+        ctrl_thread = threading.Thread(target=_run_ctrl, daemon=True)
+        ctrl_thread.start()
+        print(
+            f"[autopilot-smoke] booting replica 0 on {capacity_ports[0]} "
+            f"(capacity range {capacity_ports}) ...", flush=True,
+        )
+        t_boot = time.monotonic()
+        if not probe_ready(endpoints[:1], cfg, timeout_s=240.0):
+            print("[autopilot-smoke] FAIL: replica 0 never became ready",
+                  flush=True)
+            return 1
+        print(
+            f"[autopilot-smoke] replica 0 ready in "
+            f"{time.monotonic() - t_boot:.1f}s", flush=True,
+        )
+        # Probe + grading only start against a ready fleet: boot-time
+        # timeouts must not pre-burn the scale-out rule before any load.
+        probe_thread = threading.Thread(target=_probe, daemon=True)
+        probe_thread.start()
+        threading.Thread(target=_grade, daemon=True).start()
+        time.sleep(2.0)
+
+        print(
+            f"[autopilot-smoke] diurnal sweep {SCHEDULE} rps "
+            f"({args.clients} clients)", flush=True,
+        )
+        doc = run_loadgen(
+            cfg, endpoints, n_clients=args.clients, schedule=SCHEDULE,
+            out_path=out_path, n_procs=2, rows=1, slo_spec=STAGE_SLO,
+        )
+
+        # Dashboard leg while the controller is still live: the frame the
+        # operator would see must carry the AUTOPILOT panel.
+        ap_live = fetch_json(f"http://127.0.0.1:{http_port}/autopilot", 3.0)
+        if isinstance(ap_live, dict) and "error" in ap_live:
+            ap_live = None
+        frame = build_frame([], None, None, autopilot_doc=ap_live)
+    finally:
+        ctrl.sup.stop_event.set()
+        time.sleep(0.1)
+        stop.set()
+    ctrl_thread.join(timeout=60.0)
+    server.close()
+    # The probe thread may be mid-act: let it notice `stop` before its
+    # client's sockets go away under it.
+    if probe_thread is not None:
+        probe_thread.join(timeout=20.0)
+    probe_cl.close()
+    pub.close()
+    stat_sub.close()
+
+    for stage in doc["stages"]:
+        print(json.dumps(stage), flush=True)
+
+    events = []
+    audit_path = os.path.join(result_dir, "autopilot.jsonl")
+    if os.path.exists(audit_path):
+        with open(audit_path) as f:
+            events = [json.loads(line) for line in f if line.strip()]
+    actions = [e for e in events if e.get("ev") == "action"]
+    ap_doc = result.get("autopilot") or {}
+
+    failures = []
+    if ctrl_thread.is_alive():
+        failures.append("controller never stopped")
+    if not ap_doc.get("ok"):
+        failures.append(f"autopilot run not ok: {ap_doc}")
+    if len(doc["stages"]) != len(SCHEDULE):
+        failures.append(
+            f"expected {len(SCHEDULE)} stages, got {len(doc['stages'])}"
+        )
+    success = doc["overall"]["success_rate"]
+    if success < 1.0:
+        failures.append(
+            f"overall success {success} < 1.0 — "
+            f"{doc['overall']['sent'] - doc['overall']['ok']} requests failed"
+        )
+    floors = [s["version_floor"] for s in doc["stages"]]
+    if any(b < a for a, b in zip(floors, floors[1:])):
+        failures.append(f"version floor regressed across stages: {floors}")
+    if floors and floors[-1] < 1:
+        failures.append(f"floor never rose ({floors})")
+    for idx in (0, len(doc["stages"]) - 1):
+        slo = doc["stages"][idx].get("slo") if doc["stages"] else None
+        if not (slo and slo["ok"]):
+            failures.append(f"valley stage {idx} SLO not green: {slo}")
+    # The closed loop itself: out under the peak, back in after it.
+    outs = [a for a in actions if a["action"] == "scale_out"]
+    ins = [a for a in actions if a["action"] == "scale_in"]
+    peak = max((a["replicas"] for a in actions), default=1)
+    final = ap_doc.get("replicas", peak)
+    if not outs or peak < 2:
+        failures.append(f"never scaled out (peak {peak}): {actions}")
+    if not ins:
+        failures.append("never scaled back in")
+    if final >= peak:
+        failures.append(f"final replicas {final} not below peak {peak}")
+    for a in actions:
+        complaint = validate_action(a)
+        if complaint:
+            failures.append(f"action record {a}: {complaint}")
+    kills = [
+        e for e in events
+        if e.get("ev") == "chaos" and e.get("action") == "kill"
+    ]
+    respawns = [e for e in events if e.get("ev") == "respawn"]
+    if not kills:
+        failures.append("chaos kill never fired")
+    if not respawns:
+        failures.append("killed replica was never respawned")
+    reprobes = sum(s.get("reprobes", 0) for s in doc["stages"])
+    if reprobes < 1:
+        failures.append(
+            "drivers never re-probed a lane — scale-out adoption untested"
+        )
+    if not any("AUTOPILOT" in line for line in frame):
+        failures.append(f"dashboard frame has no AUTOPILOT panel: {ap_live}")
+
+    if failures:
+        for f in failures:
+            print(f"[autopilot-smoke] FAIL: {f}", flush=True)
+        return 1
+    print(
+        f"[autopilot-smoke] OK: success {success:.4%}, floors {floors}, "
+        f"replicas peaked at {peak} and settled at {final} "
+        f"({len(outs)} out / {len(ins)} in, {len(kills)} chaos kill "
+        f"absorbed, {reprobes} driver reprobes), audit at {audit_path}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

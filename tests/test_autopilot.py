@@ -1,0 +1,473 @@
+"""Autopilot subsystem tests (ISSUE 17): the spec grammar's error matrix
+(every bad clause named in its ValueError), the decision engine against
+synthetic signal traces — slow drift never triggers, a sustained burn fires
+exactly once per cooldown, bounds and the global rate limit clamp, flapping
+signals produce zero oscillation, hysteresis resets the opposing rule — the
+windowed signal store + scraper over canned endpoint documents, the /slo
+burn-rate history satellite, the loadgen schedule normalization, and the
+dashboard's autopilot panel."""
+
+import json
+
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.autopilot import (
+    AutopilotSpec,
+    DecisionEngine,
+    SignalScraper,
+    SignalStore,
+)
+from tpu_rl.loadgen.driver import normalize_schedule
+from tpu_rl.obs.slo import BURN_HISTORY_LEN, SloEngine
+
+
+# A deterministic, steppable clock for every stateful component under test.
+class _Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 1.0) -> float:
+        self.t += dt
+        return self.t
+
+
+OUT_RULE = "scale_out:replicas?burn:inference-rtt>0.5@sustain=3@cooldown=10s@max=3"
+IN_RULE = "scale_in:replicas?burn:inference-rtt<0.05@sustain=3@cooldown=10s@min=1"
+
+
+def _engine(spec: str, clock=None) -> DecisionEngine:
+    return DecisionEngine(
+        AutopilotSpec.parse(spec), clock=clock or _Clock()
+    )
+
+
+# ----------------------------------------------------------------- grammar
+class TestSpecGrammar:
+    def test_full_spec_parses_with_defaults_and_qualifiers(self):
+        spec = AutopilotSpec.parse(
+            f"{OUT_RULE}, {IN_RULE},"
+            "respawn:worker?straggler:score>8@sustain=10@cooldown=60s,"
+            "limit=4/30s"
+        )
+        assert len(spec.rules) == 3
+        out, inn, resp = spec.rules
+        assert (out.action, out.target, out.signal) == (
+            "scale_out", "replicas", "burn:inference-rtt"
+        )
+        assert (out.sustain, out.cooldown_s, out.hi, out.lo) == (3, 10.0, 3, None)
+        assert (inn.action, inn.lo, inn.hi) == ("scale_in", 1, None)
+        assert (resp.action, resp.target, resp.sustain) == (
+            "respawn", "worker", 10
+        )
+        assert resp.step == 1  # default
+        assert (spec.limit_n, spec.limit_window_s) == (4, 30.0)
+
+    def test_empty_spec_is_a_do_nothing_pilot(self):
+        spec = AutopilotSpec.parse("  ")
+        assert spec.rules == ()
+        assert _engine("").decide({}, {"replicas": 1}) == []
+
+    # Every bad clause must surface in its own ValueError, verbatim, so a
+    # config typo points at the offending clause, not a stack trace.
+    @pytest.mark.parametrize("clause", [
+        "scale_sideways:replicas?burn:x>1",        # unknown action
+        "scale_out:gpus?burn:x>1",                 # unknown scale target
+        "respawn:replicas?straggler:score>8",      # respawn must target worker
+        "scale_out:replicas burn:x>1",             # no '?' separator
+        "scale_out:replicas?burn:x~1",             # no comparison op
+        "scale_out:replicas?vibes:x>1",            # unknown signal kind
+        "scale_out:replicas?burn:>1",              # empty signal name
+        "scale_out:replicas?burn:x>fast",          # non-float threshold
+        "scale_out:replicas?burn:x>1@volume=11",   # unknown qualifier
+        "scale_out:replicas?burn:x>1@sustain=0",   # sustain below 1
+        "scale_out:replicas?burn:x>1@sustain=two", # non-integer sustain
+        "scale_out:replicas?burn:x>1@cooldown=5",  # cooldown missing 's'
+        "scale_out:replicas?burn:x>1@step=0",      # step below 1
+        "scale_out:replicas?burn:x>1@min=3@max=1", # min > max
+        "limit=0/60s",                             # limit count below 1
+        "limit=6/60",                              # limit window missing 's'
+        "limit=6",                                 # limit missing '/<seconds>s'
+    ])
+    def test_bad_clause_error_names_the_clause(self, clause):
+        with pytest.raises(ValueError) as exc:
+            AutopilotSpec.parse(f"{OUT_RULE},{clause}")
+        assert clause in str(exc.value)
+
+    def test_config_validate_parse_checks_the_spec(self):
+        cfg = small_config(autopilot_spec=f"{OUT_RULE},{IN_RULE}")
+        assert cfg.autopilot_spec is not None
+        with pytest.raises(ValueError, match="scale_sideways"):
+            small_config(autopilot_spec="scale_sideways:replicas?burn:x>1")
+        with pytest.raises(AssertionError):
+            small_config(autopilot_spec=OUT_RULE, autopilot_poll_s=0.0)
+        with pytest.raises(AssertionError):
+            small_config(autopilot_spec=OUT_RULE, autopilot_drain_s=-1.0)
+
+
+# ------------------------------------------------------------------ engine
+class TestDecisionEngine:
+    def test_slow_drift_never_triggers(self):
+        # The burn grazes the threshold every other poll: the streak resets
+        # each dip, so sustain=3 is never reached over a long trace.
+        clock = _Clock()
+        eng = _engine(OUT_RULE, clock)
+        for i in range(50):
+            burn = 0.6 if i % 2 == 0 else 0.3
+            assert eng.decide(
+                {"burn:inference-rtt": burn}, {"replicas": 1},
+                now=clock.tick(),
+            ) == []
+        assert eng.n_decisions == 0
+
+    def test_sustained_burn_fires_exactly_once_per_cooldown(self):
+        clock = _Clock()
+        eng = _engine(OUT_RULE, clock)
+        fired_at = []
+        replicas = 1
+        for _ in range(25):
+            now = clock.tick()
+            out = eng.decide(
+                {"burn:inference-rtt": 0.9}, {"replicas": replicas}, now=now
+            )
+            if out:
+                (d,) = out
+                fired_at.append(now)
+                replicas = d["to"]
+        # Poll 3 arms the sustain; each firing then burns a 10s cooldown
+        # AND resets the streak (hysteresis on its own target), so the next
+        # firing needs cooldown lapse + a fresh 3-poll sustain.
+        assert fired_at[0] == 3.0
+        assert all(b - a >= 10.0 for a, b in zip(fired_at, fired_at[1:]))
+        assert replicas == 3  # clamped by @max=3 thereafter
+        d_first = None
+        eng2, clock2 = _engine(OUT_RULE, _Clock()), None
+        for _ in range(3):
+            out = eng2.decide({"burn:inference-rtt": 0.9}, {"replicas": 1})
+            if out:
+                d_first = out[0]
+        assert d_first is not None
+        assert d_first["action"] == "scale_out"
+        assert (d_first["from"], d_first["to"], d_first["step"]) == (1, 2, 1)
+        assert "sustained 3 polls" in d_first["reason"]
+
+    def test_bounds_clamp_without_burning_cooldown(self):
+        clock = _Clock()
+        eng = _engine(OUT_RULE, clock)
+        # Already at max=3: the rule keeps arming but every firing is
+        # clamped — no decision, no cooldown burned, so the INSTANT the
+        # count drops it fires on the very next poll.
+        for _ in range(6):
+            assert eng.decide(
+                {"burn:inference-rtt": 0.9}, {"replicas": 3},
+                now=clock.tick(),
+            ) == []
+        assert eng.n_clamped >= 1
+        assert eng.n_decisions == 0
+        out = eng.decide(
+            {"burn:inference-rtt": 0.9}, {"replicas": 2}, now=clock.tick()
+        )
+        assert [d["to"] for d in out] == [3]
+
+    def test_scale_in_never_goes_below_min_or_zero(self):
+        clock = _Clock()
+        eng = _engine(IN_RULE, clock)
+        for _ in range(10):
+            assert eng.decide(
+                {"burn:inference-rtt": 0.0}, {"replicas": 1},
+                now=clock.tick(),
+            ) == []  # min=1 pins it
+        eng2 = _engine("scale_in:workers?gauge:idle>0.9@sustain=1", _Clock())
+        assert eng2.decide({"gauge:idle": 1.0}, {"workers": 0}, now=1.0) == []
+        assert eng2.n_clamped == 1
+
+    def test_global_rate_limit_caps_fleet_churn(self):
+        # Two independent 1-poll rules + limit=2/100s: only two firings
+        # land inside the window no matter how loud the signals are.
+        clock = _Clock()
+        eng = _engine(
+            "scale_out:replicas?burn:a>0.5@sustain=1@cooldown=1s@max=99,"
+            "scale_out:workers?burn:b>0.5@sustain=1@cooldown=1s@max=99,"
+            "limit=2/100s",
+            clock,
+        )
+        n_fired = 0
+        for _ in range(10):
+            out = eng.decide(
+                {"burn:a": 1.0, "burn:b": 1.0},
+                {"replicas": 1, "workers": 1},
+                now=clock.tick(2.0),
+            )
+            n_fired += len(out)
+        assert n_fired == 2
+        assert eng.n_rate_limited > 0
+
+    def test_flapping_signal_causes_zero_oscillation(self):
+        # A square wave that would thrash a naive controller: opposing
+        # rules on one target, signal flipping every poll. Sustain + the
+        # streak reset must keep the fleet perfectly still.
+        clock = _Clock()
+        eng = _engine(f"{OUT_RULE},{IN_RULE}", clock)
+        for i in range(100):
+            burn = 0.9 if i % 2 == 0 else 0.0
+            assert eng.decide(
+                {"burn:inference-rtt": burn}, {"replicas": 2},
+                now=clock.tick(),
+            ) == []
+        assert eng.n_decisions == 0
+
+    def test_hysteresis_resets_the_opposing_rule(self):
+        # scale_in is one poll from arming when scale_out fires: the
+        # firing must reset scale_in's streak, so even when the burn then
+        # collapses scale_in needs its FULL sustain again.
+        clock = _Clock()
+        eng = _engine(
+            "scale_out:replicas?burn:x>0.5@sustain=2@cooldown=1s@max=5,"
+            "scale_in:replicas?burn:y<0.1@sustain=3@cooldown=1s@min=1",
+            clock,
+        )
+        eng.decide({"burn:x": 0.9, "burn:y": 0.0}, {"replicas": 2}, now=1.0)
+        eng.decide({"burn:x": 0.9, "burn:y": 0.0}, {"replicas": 2}, now=2.0)
+        assert eng.n_decisions == 1  # scale_out fired at poll 2
+        # scale_in had streak 2 of 3; the firing reset it to 0 — two quiet
+        # polls must NOT fire it, the third may.
+        assert eng.decide({"burn:y": 0.0}, {"replicas": 3}, now=3.0) == []
+        assert eng.decide({"burn:y": 0.0}, {"replicas": 3}, now=4.0) == []
+        out = eng.decide({"burn:y": 0.0}, {"replicas": 3}, now=5.0)
+        assert [d["action"] for d in out] == ["scale_in"]
+
+    def test_missing_signal_holds_the_streak(self):
+        eng = _engine(OUT_RULE, _Clock())
+        eng.decide({"burn:inference-rtt": 0.9}, {"replicas": 1}, now=1.0)
+        eng.decide({"burn:inference-rtt": 0.9}, {"replicas": 1}, now=2.0)
+        # Scrape blip: no data. Silence is not evidence — streak holds.
+        assert eng.decide({}, {"replicas": 1}, now=3.0) == []
+        out = eng.decide(
+            {"burn:inference-rtt": 0.9}, {"replicas": 1}, now=4.0
+        )
+        assert [d["action"] for d in out] == ["scale_out"]
+
+    def test_respawn_carries_the_straggler_wid(self):
+        eng = _engine("respawn:worker?straggler:score>8@sustain=1", _Clock())
+        # No wid in meta: clamped, not fired — the rule stays armed.
+        assert eng.decide({"straggler:score": 9.0}, {"workers": 2}, now=1.0) == []
+        assert eng.n_clamped == 1
+        out = eng.decide(
+            {"straggler:score": 9.0}, {"workers": 2},
+            now=2.0, meta={"straggler_wid": 7},
+        )
+        assert [(d["action"], d["wid"], d["step"]) for d in out] == [
+            ("respawn", 7, 0)
+        ]
+
+    def test_cooldowns_report_remaining_seconds(self):
+        clock = _Clock()
+        eng = _engine(OUT_RULE, clock)
+        for _ in range(3):
+            eng.decide(
+                {"burn:inference-rtt": 0.9}, {"replicas": 1},
+                now=clock.tick(),
+            )
+        cd = eng.cooldowns(now=clock.t)
+        assert cd[OUT_RULE] == 10.0
+        assert eng.cooldowns(now=clock.t + 99.0)[OUT_RULE] == 0.0
+
+
+# ----------------------------------------------------------- signal plane
+class TestSignalStore:
+    def test_window_trim_and_monotonic_guard(self):
+        clock = _Clock()
+        store = SignalStore(window_s=10.0, clock=clock)
+        for t in range(1, 16):
+            store.put("burn:x", t / 100.0, t=float(t))
+        series = store.series("burn:x")
+        assert series[0][0] >= 5.0  # trimmed to the 10s window
+        assert store.latest("burn:x") == 0.15
+        # History replay overlapping what the store already has must not
+        # duplicate or reorder points.
+        store.put("burn:x", 0.99, t=14.0)
+        assert store.latest("burn:x") == 0.15
+        assert store.snapshot() == {"burn:x": 0.15}
+
+
+def _canned_scraper(slo=None, goodput=None, metrics=None):
+    def fetch_json_fn(url, timeout):
+        if url.endswith("/slo"):
+            return slo
+        if url.endswith("/goodput"):
+            return goodput
+        return None
+
+    def fetch_fn(url, timeout):
+        if metrics is None:
+            return None, "refused"
+        return 200, metrics
+
+    store = SignalStore(clock=_Clock(100.0))
+    return SignalScraper(
+        "http://x", store=store,
+        fetch_fn=fetch_fn, fetch_json_fn=fetch_json_fn,
+    )
+
+
+class TestSignalScraper:
+    def test_slo_burn_and_history_replay(self):
+        scraper = _canned_scraper(slo={
+            "ok": False,
+            "rules": [
+                {"rule": "p99:inference-rtt<5ms", "metric": "inference-rtt",
+                 "burn_rate": 0.4,
+                 "burn_history": [[98.0, 0.1], [99.0, 0.25]]},
+                {"rule": "p50:inference-rtt<1ms", "metric": "inference-rtt",
+                 "burn_rate": 0.7, "burn_history": []},
+            ],
+        }, metrics="")  # empty-but-healthy /metrics: not an error
+        signals, meta = scraper.poll(now=100.0)
+        # Two rules watch one metric: the worst burn governs.
+        assert signals == {"burn:inference-rtt": 0.7}
+        assert meta == {}
+        # The server-side history landed in the store under the live point.
+        assert scraper.store.series("burn:inference-rtt") == [
+            (98.0, 0.1), (99.0, 0.25), (100.0, 0.7)
+        ]
+        assert scraper.n_errors == 0
+
+    def test_goodput_role_means_and_straggler_meta(self):
+        scraper = _canned_scraper(goodput={
+            "roles": {
+                "worker/11": {"goodput": 0.4},
+                "worker/12": {"goodput": 0.8},
+                "storage/1": {"goodput": 0.9},
+            },
+            "stragglers": [
+                {"wid": 3, "score": 12.5, "signals": {}},
+                {"wid": 4, "score": 2.0, "signals": {}},
+            ],
+        })
+        signals, meta = scraper.poll(now=100.0)
+        assert signals["goodput:worker"] == pytest.approx(0.6)
+        assert signals["goodput:storage"] == pytest.approx(0.9)
+        assert signals["straggler:score"] == 12.5
+        assert meta == {"straggler_wid": 3}
+
+    def test_metrics_gauge_max_counter_sum_and_dash_mapping(self):
+        body = "\n".join([
+            "# TYPE worker_frame_rate gauge",
+            'worker_frame_rate{wid="1"} 50.0',
+            'worker_frame_rate{wid="2"} 80.0',
+            "# TYPE fleet_hedge_fired counter",
+            'fleet_hedge_fired{wid="1"} 3',
+            'fleet_hedge_fired{wid="2"} 4',
+            "# TYPE inference_rtt histogram",
+            "inference_rtt_count 9",
+        ])
+        scraper = _canned_scraper(metrics=body)
+        signals, _meta = scraper.poll(now=100.0)
+        assert signals["gauge:worker-frame-rate"] == 80.0  # fleet max
+        assert signals["counter:fleet-hedge-fired"] == 7.0  # fleet sum
+        # Histogram families never masquerade as gauges or counters.
+        assert not any("inference-rtt" in k for k in signals)
+
+    def test_unreachable_endpoints_count_errors_not_signals(self):
+        scraper = _canned_scraper()
+        signals, meta = scraper.poll(now=100.0)
+        assert signals == {} and meta == {}
+        assert scraper.n_errors == 2  # /slo + /metrics; /goodput 404 is normal
+
+
+# ------------------------------------------------------ /slo burn history
+class TestBurnHistory:
+    def test_burn_history_rides_every_rule_row(self):
+        clock = _Clock()
+        eng = SloEngine("gauge:learner-mfu>0.5@window=5s", clock=clock)
+        snap_bad = [{"gauges": [("learner-mfu", (), 0.1)]}]
+        snap_good = [{"gauges": [("learner-mfu", (), 0.9)]}]
+        for _ in range(3):
+            doc = eng.evaluate(snap_bad, now=clock.tick())
+        (row,) = doc["rules"]
+        assert row["burn_rate"] == 1.0
+        assert row["burn_history"] == [[1.0, 1.0], [2.0, 1.0], [3.0, 1.0]]
+        for _ in range(3):
+            doc = eng.evaluate(snap_good, now=clock.tick())
+        (row,) = doc["rules"]
+        assert row["burn_history"][-1][1] == 0.5  # 3 bad / 6 in window
+        assert len(row["burn_history"]) == 6
+        assert eng.report()["rules"][0]["burn_history"] == row["burn_history"]
+
+    def test_burn_history_is_bounded(self):
+        clock = _Clock()
+        eng = SloEngine("gauge:g>0.5", clock=clock)
+        snap = [{"gauges": [("g", (), 0.0)]}]
+        for _ in range(BURN_HISTORY_LEN + 50):
+            doc = eng.evaluate(snap, now=clock.tick())
+        assert len(doc["rules"][0]["burn_history"]) == BURN_HISTORY_LEN
+
+    def test_skeleton_report_has_empty_history(self):
+        eng = SloEngine("gauge:g>0.5")
+        assert eng.report()["rules"][0]["burn_history"] == []
+
+
+# -------------------------------------------------------- loadgen schedule
+class TestLoadgenSchedule:
+    def test_diurnal_schedule_normalizes(self):
+        plan = normalize_schedule([(100, 10), (5000.0, 30), ("100", 10)])
+        assert plan == [(100.0, 10.0), (5000.0, 30.0), (100.0, 10.0)]
+
+    @pytest.mark.parametrize("schedule,needle", [
+        ([], "empty"),
+        ([(100, 0)], "stage 0"),
+        ([(100, 10), (-1, 5)], "stage 1"),
+        ([(100, 10), "fast"], "stage 1"),
+    ])
+    def test_bad_schedule_names_the_stage(self, schedule, needle):
+        with pytest.raises(ValueError, match=needle):
+            normalize_schedule(schedule)
+
+    def test_run_loadgen_refuses_ambiguous_modes(self):
+        from tpu_rl.loadgen.driver import run_loadgen
+
+        cfg = small_config()
+        with pytest.raises(ValueError, match="exactly one"):
+            run_loadgen(cfg, [("127.0.0.1", 1)], 1)
+        with pytest.raises(ValueError, match="exactly one"):
+            run_loadgen(
+                cfg, [("127.0.0.1", 1)], 1,
+                rates=[1.0], schedule=[(1.0, 1.0)],
+            )
+
+
+# -------------------------------------------------------- dashboard panel
+class TestTopAutopilotPanel:
+    def test_panel_renders_counts_actions_and_cooldowns(self):
+        from tpu_rl.obs import top
+
+        doc = {
+            "replicas": 2, "replica_capacity": 3, "workers": 1,
+            "counts": {"actions": 4},
+            "actions": [{
+                "action": "scale_out", "target": "replicas",
+                "from": 1, "to": 2,
+                "reason": "burn:inference-rtt > 0.5 sustained 3 polls",
+            }],
+            "cooldowns": {OUT_RULE: 6.5, IN_RULE: 0.0},
+        }
+        frame = "\n".join(
+            top.build_frame([], None, None, width=200, autopilot_doc=doc)
+        )
+        assert "AUTOPILOT  replicas 2/3  workers 1  actions 4" in frame
+        assert "scale_out" in frame and "1->2" in frame
+        assert "cooldown 6.5s" in frame and "armed" in frame
+        # No autopilot wired: the panel simply does not render.
+        quiet = "\n".join(top.build_frame([], None, None))
+        assert "AUTOPILOT" not in quiet
+
+    def test_status_doc_round_trips_json(self):
+        # The /autopilot payload the panel consumes must be JSON-clean.
+        doc = {
+            "replicas": 1, "replica_capacity": 3, "workers": 0,
+            "actions": [], "cooldowns": {}, "counts": {}, "signals": {},
+        }
+        assert json.loads(json.dumps(doc)) == doc

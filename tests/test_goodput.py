@@ -298,7 +298,8 @@ def test_top_loop_renders_one_frame_with_mock_terminal():
         url="http://127.0.0.1:1/metrics", interval=0.01, timeout=0.1
     )
     with mock.patch.object(
-        top, "collect", return_value=(samples, goodput_doc, slo_doc, False)
+        top, "collect",
+        return_value=(samples, goodput_doc, slo_doc, None, False),
     ):
         assert top._loop(stdscr, args) == 0
     stdscr.erase.assert_called()

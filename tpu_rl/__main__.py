@@ -24,9 +24,14 @@ from tpu_rl.config import Config, MachinesConfig, default_result_dirs
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="tpu_rl")
     p.add_argument(
-        "role", choices=["local", "learner", "manager", "worker", "population"],
+        "role",
+        choices=[
+            "local", "learner", "manager", "worker", "population", "autopilot",
+        ],
         help="which role this host runs ('population' = PBT controller "
-        "orchestrating K member runs; see tpu_rl.population)",
+        "orchestrating K member runs; 'autopilot' = closed-loop autoscaler "
+        "driving the elastic inference fleet from SLO burn rates, goodput "
+        "and straggler scores; see tpu_rl.population / tpu_rl.autopilot)",
     )
     p.add_argument("--params", help="parameters.json-shaped config file")
     p.add_argument("--machines", help="machines.json-shaped topology file")
@@ -133,6 +138,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pop-seed", type=int, default=None,
                    help="seed for population sampling/mutation/selection "
                    "(deterministic per-member streams)")
+    p.add_argument("--autopilot-spec", default=None,
+                   help="closed-loop autoscaling rules for the autopilot "
+                   "role, e.g. 'scale_out:replicas?burn:inference-rtt>0.5"
+                   "@sustain=3@cooldown=10s@max=4,scale_in:replicas?burn:"
+                   "inference-rtt<0.05@min=1,limit=6/60s' "
+                   "(see tpu_rl.autopilot.policy)")
+    p.add_argument("--autopilot-poll", type=float, default=None,
+                   help="seconds between autopilot control ticks "
+                   "(scrape -> decide -> actuate)")
+    p.add_argument("--autopilot-manage-all", action="store_true",
+                   help="autopilot owns the whole replica range from index "
+                   "0 (standalone fleets); default: the statically "
+                   "provisioned learner-owned replicas stay untouched and "
+                   "the autopilot manages only the elastic tail")
     p.add_argument("--heartbeat-timeout", type=float, default=None,
                    help="seconds of child-heartbeat silence before the "
                    "supervisor declares it hung and restarts it")
@@ -192,6 +211,10 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["pop_spec"] = args.pop_spec
     if args.pop_seed is not None:
         overrides["pop_seed"] = args.pop_seed
+    if args.autopilot_spec is not None:
+        overrides["autopilot_spec"] = args.autopilot_spec
+    if args.autopilot_poll is not None:
+        overrides["autopilot_poll_s"] = args.autopilot_poll
     if args.chaos_seed is not None:
         overrides["chaos_seed"] = args.chaos_seed
     if args.heartbeat_timeout is not None:
@@ -254,6 +277,15 @@ def main(argv: list[str] | None = None) -> int:
         # not go through the sup.loop() path below.
         ctrl = runner.population_role(
             cfg, machines, max_updates=args.max_updates
+        )
+        ctrl.install_signal_handlers()
+        doc = ctrl.run()
+        return 0 if doc.get("ok") else 1
+    if args.role == "autopilot":
+        # Same controller-as-orchestrator shape as the population role.
+        ctrl = runner.autopilot_role(
+            cfg, machines, manage_all=args.autopilot_manage_all,
+            seed=args.seed,
         )
         ctrl.install_signal_handlers()
         doc = ctrl.run()

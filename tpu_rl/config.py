@@ -534,6 +534,21 @@ class Config:
     # ...), so identical (pop_spec, pop_seed) reproduce identical
     # populations.
     pop_seed: int = 0
+    # ---- autopilot plane (tpu_rl.autopilot) ----
+    # Closed-loop autoscaling rules mapping fleet health signals to
+    # scale/respawn actions, e.g.
+    # "scale_out:replicas?burn:inference-rtt>0.5@sustain=3@cooldown=10s@max=4,
+    #  scale_in:replicas?burn:inference-rtt<0.05@sustain=8@min=1,
+    #  respawn:worker?straggler:score>8@cooldown=60s,limit=6/60s".
+    # Grammar and anti-flap semantics (sustain/cooldown/hysteresis/bounds/
+    # rate limit): tpu_rl/autopilot/policy.py. Parse-checked at config
+    # load, like chaos_spec/pop_spec. None = no engine, no controller.
+    autopilot_spec: str | None = None
+    # Seconds between autopilot control ticks (scrape -> decide -> actuate).
+    autopilot_poll_s: float = 1.0
+    # Grace between a scale-in decision and the replica kill, so in-flight
+    # requests (ms-scale) complete; clients hedge over the tail.
+    autopilot_drain_s: float = 0.5
     # Rollout-lineage sampling: every Nth worker tick ships a 28-byte trace
     # context (wid, seq, trace id, send timestamp) as an optional THIRD wire
     # part; each hop (worker, manager, storage, assembler, learner) records
@@ -759,6 +774,15 @@ class Config:
 
             PopSpec.parse(self.pop_spec).check_searchable()
         assert self.pop_seed >= 0, self.pop_seed
+        if self.autopilot_spec:
+            # Same fail-at-load contract as chaos/slo/pop specs: a typo'd
+            # rule dies at config load with the offending clause named.
+            # policy.py is stdlib-only, so this import stays cheap.
+            from tpu_rl.autopilot.policy import AutopilotSpec
+
+            AutopilotSpec.parse(self.autopilot_spec)
+        assert self.autopilot_poll_s > 0, self.autopilot_poll_s
+        assert self.autopilot_drain_s >= 0, self.autopilot_drain_s
         assert 0 <= self.telemetry_port < 65536, self.telemetry_port
         assert self.telemetry_interval_s > 0, self.telemetry_interval_s
         assert self.telemetry_stale_s > 0, self.telemetry_stale_s

@@ -19,10 +19,18 @@ What changes underneath:
   loser's late duplicate is discarded (counted, exactly once). With
   ``inference_hedge_ms=0`` the hedge fires only at the full
   ``inference_timeout_ms`` boundary — plain failover;
-- **failover**: a lane that times out is marked dead for
-  ``Config.inference_reprobe_s`` and selection routes around it; when EVERY
-  lane is dead the least-recently-condemned one is probed anyway, so a
-  blip that condemned the whole fleet cannot strand the client forever;
+- **failover**: a lane that times out is condemned and selection routes
+  around it; when EVERY lane is dead the least-recently-condemned one is
+  probed anyway, so a blip that condemned the whole fleet cannot strand
+  the client forever;
+- **re-probe**: condemned lanes (including never-answered ones — replica
+  slots the autopilot hasn't populated yet) are re-probed on a doubling
+  backoff (``Config.inference_reprobe_s`` doubling per consecutive silent
+  probe up to ``inference_reprobe_max_s``). The probe piggybacks the SAME
+  in-flight request on at most one overdue condemned lane per round — no
+  extra latency, and if the probed replica answers it can even win the
+  round. ANY reply on a lane revives it instantly, so replicas scaled out
+  or respawned after this client started are adopted without a restart;
 - **version floor**: the highest ``ver`` this client ever accepted. Replies
   below the floor (a lagging replica still warming up after a join) are
   discarded while the wait continues — a client never observes weights
@@ -52,12 +60,13 @@ from tpu_rl.utils.timer import ExecutionTimer
 class _Lane:
     """One replica endpoint: its DEALER plus local health/latency state."""
 
-    __slots__ = ("dealer", "ewma_ms", "dead_until", "sent", "ok")
+    __slots__ = ("dealer", "ewma_ms", "dead_until", "fails", "sent", "ok")
 
     def __init__(self, dealer: Dealer):
         self.dealer = dealer
         self.ewma_ms = 0.0  # 0 = untried; untried lanes score best
-        self.dead_until = 0.0  # monotonic instant the condemnation lapses
+        self.dead_until = 0.0  # monotonic instant the next probe is due
+        self.fails = 0  # consecutive silent condemnations (backoff exponent)
         self.sent = 0
         self.ok = 0
 
@@ -90,6 +99,7 @@ class FleetClient:
         self.n_failovers = 0  # winning reply came from a non-primary lane
         self.n_dedups = 0  # fleet-dedup-replies: late/duplicate Act discarded
         self.n_floor_rejects = 0  # replies below the pinned version floor
+        self.n_reprobes = 0  # fleet-reprobes: piggyback probes of dead lanes
         # Seeded per worker: deterministic lane choices under test, while
         # different workers still spread across replicas.
         self._rng = random.Random(0x5EED ^ (wid * 2654435761))
@@ -127,11 +137,14 @@ class FleetClient:
         return sum(1 for lane in self.lanes if lane.dead_until <= now)
 
     def _pick(self, exclude: tuple[int, ...] = ()) -> int | None:
-        """Power-of-two-choices over live, non-excluded lanes."""
+        """Power-of-two-choices over live, non-excluded lanes. A lane with
+        ``fails > 0`` stays out of selection even after its backoff lapses —
+        only the piggyback probe (or an unsolicited reply) readmits it, so
+        real traffic is never routed to a lane that last answered nothing."""
         now = time.monotonic()
         live = [
             i for i, lane in enumerate(self.lanes)
-            if i not in exclude and lane.dead_until <= now
+            if i not in exclude and lane.fails == 0 and lane.dead_until <= now
         ]
         if not live:
             return None
@@ -141,9 +154,21 @@ class FleetClient:
         return a if self.lanes[a].ewma_ms <= self.lanes[b].ewma_ms else b
 
     def _condemn(self, idx: int) -> None:
-        self.lanes[idx].dead_until = (
-            time.monotonic() + self.cfg.inference_reprobe_s
+        """Bench a silent lane; consecutive condemnations double the wait
+        before the next probe, capped at ``inference_reprobe_max_s``."""
+        lane = self.lanes[idx]
+        lane.fails += 1
+        backoff = min(
+            self.cfg.inference_reprobe_s * 2.0 ** (lane.fails - 1),
+            self.cfg.inference_reprobe_max_s,
         )
+        lane.dead_until = time.monotonic() + backoff
+
+    def _revive(self, idx: int) -> None:
+        """Any reply is proof of life: clear the bench and the backoff."""
+        lane = self.lanes[idx]
+        lane.fails = 0
+        lane.dead_until = 0.0
 
     # ------------------------------------------------------------------- act
     def act(
@@ -174,6 +199,10 @@ class FleetClient:
         """One attempt: primary send, optional hedge, first matching reply
         wins. None = this round exhausted its lanes; condemned the losers."""
         cfg = self.cfg
+        # Sweep BEFORE selection: a late reply sitting in a condemned
+        # lane's queue is proof of life and must revive the lane in time
+        # for this round's pick, not the next one's.
+        self._drain_stale()
         primary = self._pick()
         if primary is None:
             # Whole fleet condemned: probe the lane whose condemnation
@@ -183,15 +212,16 @@ class FleetClient:
                 range(len(self.lanes)),
                 key=lambda i: self.lanes[i].dead_until,
             )
-        self._drain_stale()
         lanes_sent = [primary]
         self._send(primary, req)
+        probed = self._maybe_probe(req, lanes_sent)
         hedge_s = cfg.inference_hedge_ms / 1e3
         timeout_s = cfg.inference_timeout_ms / 1e3
         start = time.perf_counter()
         deadline = start + timeout_s
         hedged = False
         extended = False
+        answered: set[int] = set()
         while True:
             now = time.perf_counter()
             if not hedged and hedge_s > 0 and now - start >= hedge_s:
@@ -207,13 +237,20 @@ class FleetClient:
                         deadline = now + timeout_s
                         continue
                 for idx in lanes_sent:
-                    self._condemn(idx)
+                    # The probe lane was already re-condemned at send time;
+                    # condemning it again would double its backoff twice.
+                    if idx not in answered and idx != probed:
+                        self._condemn(idx)
                 self.n_timeouts += 1
                 return None
             for idx in lanes_sent:
                 got = self.lanes[idx].dealer.recv(timeout_ms=1)
                 if got is None:
                     continue
+                # Any frame at all is proof of life — a probed-back replica
+                # (or one that merely answered slowly) rejoins selection.
+                self._revive(idx)
+                answered.add(idx)
                 proto, payload = got
                 if proto != Protocol.Act or not isinstance(payload, dict):
                     continue
@@ -233,14 +270,42 @@ class FleetClient:
                 lane = self.lanes[idx]
                 lane.ok += 1
                 lane.observe((time.perf_counter() - t0) * 1e3)
-                lane.dead_until = 0.0
                 if idx != primary:
                     self.n_failovers += 1
+                    if primary not in answered:
+                        # The hedge beat a SILENT primary: condemn it now so
+                        # the next round routes around it instead of eating
+                        # another hedge window. (Its late reply, if any,
+                        # revives it on the next drain.)
+                        self._condemn(primary)
                 if self.timer is not None:
                     self.timer.record(
                         "inference-rtt", time.perf_counter() - t0
                     )
                 return payload
+
+    def _maybe_probe(self, req: dict, lanes_sent: list[int]) -> int | None:
+        """Piggyback re-probe: duplicate the in-flight request onto at most
+        ONE condemned lane whose backoff has lapsed (the most overdue one).
+        Costs nothing in latency — the round still rides its primary — and
+        an answer both revives the lane and can win the round. Silent
+        probes double the lane's backoff immediately so a replica slot that
+        does not exist yet is bothered exponentially rarely."""
+        now = time.monotonic()
+        due = [
+            i for i, lane in enumerate(self.lanes)
+            if i not in lanes_sent and lane.fails > 0 and lane.dead_until <= now
+        ]
+        if not due:
+            return None
+        idx = min(due, key=lambda i: self.lanes[i].dead_until)
+        self._send(idx, req)
+        lanes_sent.append(idx)
+        self.n_reprobes += 1
+        # Assume silence: push the next probe out now. A reply (this round
+        # or a later drain) revives the lane and clears the backoff.
+        self._condemn(idx)
+        return idx
 
     def _hedge(self, req: dict, lanes_sent: list[int]) -> bool:
         """Fire the duplicate request on a fresh lane; True if one existed."""
@@ -260,12 +325,14 @@ class FleetClient:
     def _drain_stale(self) -> None:
         """Sweep every lane's queue before a fresh round: anything sitting
         there correlates to a PAST seq (hedge losers, post-timeout
-        stragglers) and is discarded + counted."""
-        for lane in self.lanes:
+        stragglers) and is discarded + counted — but it also proves the
+        lane is alive, so the sweep revives it."""
+        for i, lane in enumerate(self.lanes):
             for _ in range(64):
                 got = lane.dealer.recv(timeout_ms=0)
                 if got is None:
                     break
+                self._revive(i)
                 proto, payload = got
                 if proto == Protocol.Act and isinstance(payload, dict):
                     self.n_dedups += 1

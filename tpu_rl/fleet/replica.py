@@ -200,6 +200,13 @@ def replica_main(
 
     from tpu_rl.models.families import build_family
 
+    # Finish the tpu_rl.obs package import on THIS thread before the serving
+    # thread starts: InferenceReplica's loop lazily imports tpu_rl.obs.perf,
+    # and two threads entering the package import concurrently trip Python's
+    # import-deadlock breaker — one of them sees a partially initialized
+    # module and the replica dies (a crash loop on scale-out respawns).
+    import tpu_rl.obs.perf  # noqa: F401
+
     family = build_family(cfg)
     params = family.init_params(
         jax.random.key(seed * 6151 + replica_id), seq_len=cfg.seq_len

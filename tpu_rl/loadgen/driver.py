@@ -39,21 +39,39 @@ from tpu_rl.obs.registry import (
 from tpu_rl.runtime.protocol import Protocol
 from tpu_rl.runtime.transport import Dealer
 
-# A lane that times out a request is benched this long before selection
-# considers it again; hedges keep traffic flowing meanwhile. Short on
-# purpose: the loadgen must notice a killed replica fast AND re-admit a
-# recovered one fast, or the saturation curve measures the bench, not the
-# fleet.
+# A lane silent past its first hedge window (or a piggyback probe) is
+# benched this long before the next probe considers it; consecutive silent
+# probes double the bench up to the cap. Short base on purpose: the loadgen
+# must notice a killed replica fast AND re-admit a recovered (or freshly
+# scaled-out) one fast, or the saturation curve measures the bench, not
+# the fleet.
 _LANE_DEAD_S = 1.0
+_LANE_DEAD_MAX_S = 8.0
+
+# Adaptive hedge window: a request hedges once it has waited this multiple
+# of its primary lane's RTT EWMA (floored/capped below). A fixed
+# `inference_hedge_ms` is a dilemma at both ends — large, and every request
+# riding a freshly-retired lane eats the full window before rescue; small,
+# and a saturated fleet hedge-storms itself. Scaling with the lane's own
+# EWMA rescues dead-lane picks in ~4 healthy RTTs while a genuinely slow
+# fleet (EWMA already high) hedges no earlier than it used to.
+_HEDGE_FLOOR_S = 0.25
+_HEDGE_EWMA_MULT = 4.0
 
 
 class _Lane:
-    __slots__ = ("dealer", "ewma_ms", "dead_until")
+    __slots__ = ("dealer", "ewma_ms", "dead_until", "fails")
 
     def __init__(self, dealer: Dealer):
         self.dealer = dealer
         self.ewma_ms = 0.0
+        # Lanes start in probation (benched but immediately probe-due): an
+        # endpoint in the planned port range may not have a replica behind
+        # it yet (autopilot capacity the fleet has not scaled into), and
+        # real traffic must never ride a lane that has not answered at
+        # least one frame. The first reply revives the lane for selection.
         self.dead_until = 0.0
+        self.fails = 1  # consecutive silent benches (backoff exponent)
 
     def observe(self, rtt_ms: float) -> None:
         self.ewma_ms = (
@@ -61,14 +79,29 @@ class _Lane:
             else 0.8 * self.ewma_ms + 0.2 * rtt_ms
         )
 
+    def condemn(self) -> None:
+        self.fails += 1
+        self.dead_until = time.monotonic() + min(
+            _LANE_DEAD_S * 2.0 ** (self.fails - 1), _LANE_DEAD_MAX_S
+        )
+
+    def revive(self) -> None:
+        self.fails = 0
+        self.dead_until = 0.0
+
 
 class _InFlight:
-    __slots__ = ("t_send", "primary", "hedged")
+    __slots__ = (
+        "t_send", "primary", "lanes", "n_hedges", "next_hedge", "hedge_s"
+    )
 
-    def __init__(self, t_send: float, primary: int):
+    def __init__(self, t_send: float, primary: int, hedge_s: float):
         self.t_send = t_send
         self.primary = primary
-        self.hedged = False
+        self.lanes = [primary]  # every lane this seq ever rode
+        self.n_hedges = 0
+        self.hedge_s = hedge_s
+        self.next_hedge = t_send + hedge_s if hedge_s > 0 else float("inf")
 
 
 class LoadDriver:
@@ -106,13 +139,25 @@ class LoadDriver:
         ]
 
     # ------------------------------------------------------------- selection
-    def _pick(self, exclude: tuple[int, ...] = ()) -> int | None:
+    def _pick(
+        self, exclude: tuple[int, ...] = (), live_only: bool = False
+    ) -> int | None:
+        """Power-of-two-choices over live lanes. Benched lanes
+        (``fails > 0``) stay out of selection until a probe reply revives
+        them — real traffic never rides a lane that last answered nothing.
+        ``live_only`` (hedges) returns None instead of falling back to a
+        benched lane: a hedge queued into a dead socket is not a rescue,
+        it is a stale-request storm delivered to whatever replica binds
+        that port later."""
         now = time.monotonic()
         live = [
             i for i, lane in enumerate(self.lanes)
-            if i not in exclude and lane.dead_until <= now
+            if i not in exclude
+            and lane.fails == 0 and lane.dead_until <= now
         ]
         if not live:
+            if live_only:
+                return None
             # All benched: probe whichever recovers first (never stall the
             # schedule — open-loop means the load keeps coming).
             rest = [i for i in range(len(self.lanes)) if i not in exclude]
@@ -123,6 +168,22 @@ class LoadDriver:
             return live[0]
         a, b = self._rng.sample(live, 2)
         return a if self.lanes[a].ewma_ms <= self.lanes[b].ewma_ms else b
+
+    def _probe_lane(self, lanes_used: list[int]) -> int | None:
+        """The most-overdue benched lane due for a piggyback re-probe, or
+        None. The caller duplicates an in-flight seq onto it: an answer
+        revives the lane (and can win the request); silence just doubled
+        the backoff — a replica slot the autopilot has not populated yet is
+        bothered exponentially rarely, one scaled out a moment ago is
+        adopted within one bench."""
+        now = time.monotonic()
+        due = [
+            i for i, lane in enumerate(self.lanes)
+            if i not in lanes_used and lane.fails > 0 and lane.dead_until <= now
+        ]
+        if not due:
+            return None
+        return min(due, key=lambda i: self.lanes[i].dead_until)
 
     def _send(self, lane_idx: int, seq: int) -> None:
         self.lanes[lane_idx].dealer.send(Protocol.ObsRequest, {
@@ -143,17 +204,26 @@ class LoadDriver:
             role="loadgen", labels={"drv": str(self.seed)}
         )
         rtt_hist = registry.histogram("inference-rtt")
-        hedge_s = cfg.inference_hedge_ms / 1e3
+        hedge_cap_s = cfg.inference_hedge_ms / 1e3
         timeout_s = cfg.inference_timeout_ms / 1e3
+
+        def hedge_window(lane: _Lane) -> float:
+            ewma_s = lane.ewma_ms / 1e3
+            if ewma_s <= 0.0:  # lane never answered: configured window
+                return hedge_cap_s
+            return min(
+                hedge_cap_s, max(_HEDGE_FLOOR_S, _HEDGE_EWMA_MULT * ewma_s)
+            )
+
         interval = 1.0 / rate_rps if rate_rps > 0 else float("inf")
         inflight: dict[int, _InFlight] = {}
         sent = ok = failed = 0
-        hedges = failovers = dedups = floor_rejects = 0
+        hedges = failovers = dedups = floor_rejects = reprobes = 0
 
         start = time.perf_counter()
         stop_sending = start + duration_s
         next_send = start
-        hard_stop = stop_sending + timeout_s + hedge_s + 0.5
+        hard_stop = stop_sending + timeout_s + hedge_cap_s + 0.5
 
         while True:
             now = time.perf_counter()
@@ -167,7 +237,19 @@ class LoadDriver:
                 if primary is None:
                     break
                 self._send(primary, self.seq)
-                inflight[self.seq] = _InFlight(now, primary)
+                entry = _InFlight(
+                    now, primary, hedge_window(self.lanes[primary])
+                )
+                # Piggyback re-probe: duplicate this seq onto at most one
+                # overdue benched lane — costs no latency, and an answer
+                # both revives the lane and can win the request.
+                probe = self._probe_lane(entry.lanes)
+                if probe is not None:
+                    self._send(probe, self.seq)
+                    entry.lanes.append(probe)
+                    reprobes += 1
+                    self.lanes[probe].condemn()  # assume silence until reply
+                inflight[self.seq] = entry
                 self.seq += 1
                 sent += 1
                 burst += 1
@@ -178,6 +260,9 @@ class LoadDriver:
                     got = lane.dealer.recv(timeout_ms=0)
                     if got is None:
                         break
+                    # Any frame is proof of life: a probed-back replica (or
+                    # a late straggler) rejoins selection immediately.
+                    lane.revive()
                     proto, payload = got
                     if proto != Protocol.Act or not isinstance(payload, dict):
                         continue
@@ -196,7 +281,6 @@ class LoadDriver:
                     rtt = time.perf_counter() - entry.t_send
                     rtt_hist.observe(rtt)
                     lane.observe(rtt * 1e3)
-                    lane.dead_until = 0.0
                     if idx != entry.primary:
                         failovers += 1
             # 3) hedge + expire
@@ -204,20 +288,38 @@ class LoadDriver:
             expired = []
             for seq, entry in inflight.items():
                 age = now - entry.t_send
-                if not entry.hedged and hedge_s > 0 and age >= hedge_s:
-                    alt = self._pick(exclude=(entry.primary,))
+                # Re-hedge every additional hedge window onto a lane this
+                # seq has not ridden yet; self-capping — _pick returns None
+                # once the unused live lanes run out.
+                if now >= entry.next_hedge:
+                    # A primary silent past its first hedge window is
+                    # benched on the spot — waiting for the full request
+                    # timeout would let a dead lane keep winning selection
+                    # (every pick rescued by a hedge, never condemned).
+                    # Any later frame on the lane revives it immediately,
+                    # so a merely-slow replica rejoins within one reply.
+                    if entry.n_hedges == 0:
+                        self.lanes[entry.primary].condemn()
+                    alt = self._pick(
+                        exclude=tuple(entry.lanes), live_only=True
+                    )
                     if alt is not None:
                         self._send(alt, seq)
-                        entry.hedged = True
+                        entry.lanes.append(alt)
+                        entry.n_hedges += 1
                         hedges += 1
+                        entry.next_hedge += entry.hedge_s
+                    else:
+                        # No live lane free right now — retry next window
+                        # (a scaled-out replica may have been adopted by
+                        # then), rather than giving up on this seq forever.
+                        entry.next_hedge += entry.hedge_s
                 if age >= timeout_s:
                     expired.append(seq)
             for seq in expired:
                 entry = inflight.pop(seq)
                 failed += 1
-                self.lanes[entry.primary].dead_until = (
-                    time.monotonic() + _LANE_DEAD_S
-                )
+                self.lanes[entry.primary].condemn()
             time.sleep(0.0005)
 
         elapsed = time.perf_counter() - start
@@ -228,6 +330,7 @@ class LoadDriver:
         registry.counter("fleet-failovers").inc(failovers)
         registry.counter("fleet-dedup-replies").inc(dedups)
         registry.counter("fleet-floor-rejects").inc(floor_rejects)
+        registry.counter("fleet-reprobes").inc(reprobes)
         registry.gauge("loadgen-offered-rate").set(rate_rps)
         registry.gauge("loadgen-achieved-rate").set(
             ok / elapsed if elapsed > 0 else 0.0
@@ -245,6 +348,7 @@ class LoadDriver:
             "failovers": failovers,
             "dedups": dedups,
             "floor_rejects": floor_rejects,
+            "reprobes": reprobes,
             "version_floor": self.floor,
             "snapshot": registry.snapshot(),
         }
@@ -290,6 +394,31 @@ def probe_ready(
 
 
 # -------------------------------------------------------------------- sweep
+def normalize_schedule(schedule) -> list[tuple[float, float]]:
+    """Validate a time-indexed rps schedule — ``[(rps, duration_s), ...]``,
+    the diurnal-ramp shape (100 -> 5000 -> 100) — into float pairs.
+    Raises ``ValueError`` naming the offending stage."""
+    out = []
+    for i, stage in enumerate(schedule):
+        try:
+            rps, dur = stage
+            rps, dur = float(rps), float(dur)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"loadgen schedule stage {i}: expected (rps, duration_s) "
+                f"pair, got {stage!r}"
+            ) from None
+        if rps < 0 or dur <= 0:
+            raise ValueError(
+                f"loadgen schedule stage {i}: need rps >= 0 and "
+                f"duration_s > 0, got ({rps}, {dur})"
+            )
+        out.append((rps, dur))
+    if not out:
+        raise ValueError("loadgen schedule is empty")
+    return out
+
+
 def _driver_proc(
     cfg: Config,
     endpoints: list[tuple[str, int]],
@@ -297,18 +426,18 @@ def _driver_proc(
     obs_dim: int,
     rows: int,
     seed: int,
-    rates: list[float],
-    duration_s: float,
+    stages: list[tuple[float, float]],
     q,
 ) -> None:
-    """Spawn-context child: run every stage of the sweep at this process's
-    share of the offered rate, shipping (seed, stage_idx, row) back."""
+    """Spawn-context child: run every (rate, duration) stage of the sweep
+    at this process's share of the offered rate, shipping
+    (seed, stage_idx, row) back."""
     driver = LoadDriver(
         cfg, endpoints, n_clients, obs_dim, rows=rows, seed=seed
     )
     try:
-        for idx, rate in enumerate(rates):
-            q.put((seed, idx, driver.run_stage(rate, duration_s)))
+        for idx, (rate, dur) in enumerate(stages):
+            q.put((seed, idx, driver.run_stage(rate, dur)))
     finally:
         driver.close()
 
@@ -317,17 +446,23 @@ def run_loadgen(
     cfg: Config,
     endpoints: list[tuple[str, int]],
     n_clients: int,
-    rates: list[float],
-    duration_s: float,
+    rates: list[float] | None = None,
+    duration_s: float = 10.0,
     out_path: str | None = None,
     n_procs: int = 1,
     rows: int = 1,
     obs_dim: int | None = None,
     slo_spec: str | None = None,
     extra_snapshots=None,
+    schedule=None,
 ) -> dict:
-    """Sweep ``rates`` (aggregate offered rps) across ``n_procs`` driver
-    processes and produce the saturation-curve document.
+    """Sweep ``rates`` (aggregate offered rps, ``duration_s`` each) across
+    ``n_procs`` driver processes and produce the saturation-curve document.
+    ``schedule`` — ``[(rps, duration_s), ...]`` — is the explicit
+    time-indexed alternative (diurnal ramps: 100 -> 5000 -> 100 with
+    per-stage dwell times); exactly one of the two must be given. Stage
+    rows and per-stage SLO verdicts are identical in both modes; a
+    schedule additionally lands in the document under ``"schedule"``.
 
     Per stage: the drivers' telemetry snapshots merge elementwise (shared
     HIST_BUCKETS make quantiles exact across processes), rtt quantiles come
@@ -345,24 +480,33 @@ def run_loadgen(
     """
     from tpu_rl.obs.slo import SloEngine
 
+    if (schedule is None) == (rates is None):
+        raise ValueError("run_loadgen: give exactly one of rates/schedule")
+    if schedule is not None:
+        plan = normalize_schedule(schedule)
+    else:
+        plan = normalize_schedule([(r, duration_s) for r in rates])
     dim = int(cfg.obs_shape[0]) if obs_dim is None else int(obs_dim)
     n_procs = max(1, int(n_procs))
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = []
     for p in range(n_procs):
-        share = [r / n_procs for r in rates]
+        share = [(r / n_procs, d) for r, d in plan]
         procs.append(ctx.Process(
             target=_driver_proc,
             args=(cfg, endpoints, max(1, n_clients // n_procs), dim, rows,
-                  p, share, duration_s, q),
+                  p, share, q),
             daemon=True,
         ))
     for proc in procs:
         proc.start()
     rows_by_stage: dict[int, list[dict]] = {}
-    expect = n_procs * len(rates)
-    budget = (duration_s + cfg.inference_timeout_ms / 1e3 + 30.0) * len(rates)
+    expect = n_procs * len(plan)
+    budget = (
+        sum(d for _r, d in plan)
+        + (cfg.inference_timeout_ms / 1e3 + 30.0) * len(plan)
+    )
     deadline = time.monotonic() + budget
     got = 0
     while got < expect and time.monotonic() < deadline:
@@ -404,6 +548,7 @@ def run_loadgen(
         stage = {
             "offered_rps": sum(r["offered_rps"] for r in per),
             "achieved_rps": round(sum(r["achieved_rps"] for r in per), 3),
+            "duration_s": plan[idx][1],
             "sent": sent,
             "ok": okc,
             "failed": sum(r["failed"] for r in per),
@@ -412,6 +557,7 @@ def run_loadgen(
             "failovers": sum(r["failovers"] for r in per),
             "dedups": sum(r["dedups"] for r in per),
             "floor_rejects": sum(r["floor_rejects"] for r in per),
+            "reprobes": sum(r.get("reprobes", 0) for r in per),
             "version_floor": max(r["version_floor"] for r in per),
             **quant,
         }
@@ -426,9 +572,10 @@ def run_loadgen(
         "n_clients": int(n_clients),
         "n_procs": n_procs,
         "rows": int(rows),
-        "duration_s": float(duration_s),
+        "duration_s": float(sum(d for _r, d in plan)),
         "endpoints": [[ip, port] for ip, port in endpoints],
         "slo_spec": slo_spec,
+        "schedule": [[r, d] for r, d in plan] if schedule is not None else None,
         "stages": stages,
         "overall": {
             "sent": tot_sent,

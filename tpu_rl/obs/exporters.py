@@ -144,7 +144,9 @@ class TelemetryHTTPServer:
     callable — ``/tracez`` (the role's live span ring + clock estimates),
     ``/slo`` (last SLO verdict: 200 while every rule holds, 503 on any hard
     failure, so probes can alert off the status line alone), ``/goodput``
-    (wall-clock attribution breakdown + straggler top-k) and ``/prof?ms=N``
+    (wall-clock attribution breakdown + straggler top-k), ``/autopilot``
+    (the autopilot controller's live status: counts, recent actions with
+    reasons, per-rule cooldowns) and ``/prof?ms=N``
     (bounded on-demand ``jax.profiler`` capture; an overlapping request is
     refused with 409). Daemonized: it must never hold the storage process
     open at shutdown, and :meth:`close` is idempotent and bounded so cluster
@@ -160,12 +162,14 @@ class TelemetryHTTPServer:
         slo=None,
         prof=None,
         goodput=None,
+        autopilot=None,
     ):
         self.agg = agg
         self.tracez = tracez  # callable -> JSON-able dict, or None
         self.slo = slo  # callable -> SLO report dict, or None
         self.prof = prof  # callable (ms|None) -> (started, path|reason)
         self.goodput = goodput  # callable -> goodput/straggler doc, or None
+        self.autopilot = autopilot  # callable -> autopilot status doc, or None
 
         outer = self
 
@@ -199,6 +203,13 @@ class TelemetryHTTPServer:
                         payload, status = {"error": "goodput ledger not wired"}, 404
                     else:
                         payload, status = outer.goodput(), 200
+                    body = (json.dumps(payload, indent=1) + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/autopilot":
+                    if outer.autopilot is None:
+                        payload, status = {"error": "no autopilot wired"}, 404
+                    else:
+                        payload, status = outer.autopilot(), 200
                     body = (json.dumps(payload, indent=1) + "\n").encode()
                     ctype = "application/json"
                 elif path == "/prof":

@@ -42,7 +42,11 @@ source satisfies it:
 
 ``burn_rate`` is the fraction of evaluations inside the rule's window that
 violated (0.0 healthy, 1.0 hard-down) — the error-budget-burn view that
-distinguishes a blip from a sustained breach.
+distinguishes a blip from a sustained breach. Each rule also carries
+``burn_history``, the last :data:`BURN_HISTORY_LEN` ``[t, burn]`` points
+(one per evaluate pass), so sustain/hysteresis consumers — the autopilot's
+decision engine, the dashboard — read the exact series the verdicts were
+scored on instead of re-deriving it from scrapes.
 
 Pure stdlib + registry math, so ``Config.validate()`` can parse-check specs
 without importing jax, and golden-fixture tests are exactly reproducible.
@@ -71,6 +75,11 @@ _OPS: tuple[tuple[str, Callable[[float, float], bool]], ...] = (
 )
 _UNITS = {"us": 1e-6, "ms": 1e-3, "s": 1.0, "/s": 1.0}
 DEFAULT_WINDOW_S = 60.0
+# Burn-rate points kept per rule for the /slo payload's burn_history —
+# the same series the autopilot's sustain/hysteresis windows and the
+# dashboard read (one point per evaluate tick, so 120 covers two minutes
+# at the storage 1 Hz cadence).
+BURN_HISTORY_LEN = 120
 
 
 @dataclass(frozen=True)
@@ -232,6 +241,12 @@ class SloEngine:
         self._verdicts: list[deque] = [deque() for _ in self.rules]
         # Per rate-rule: (t, cumulative total) for differentiation.
         self._totals: list[deque] = [deque() for _ in self.rules]
+        # Per rule: (t, burn_rate) — one point per evaluate pass, served
+        # in the /slo payload so sustain/hysteresis consumers (autopilot,
+        # dashboard) read the exact series the engine decided on.
+        self._burn_hist: list[deque] = [
+            deque(maxlen=BURN_HISTORY_LEN) for _ in self.rules
+        ]
         self._last: dict | None = None
 
     def evaluate(self, source, now: float | None = None) -> dict:
@@ -264,6 +279,7 @@ class SloEngine:
                 if verdicts
                 else 0.0
             )
+            self._burn_hist[i].append((now, round(burn, 6)))
             results.append(
                 {
                     "rule": rule.raw,
@@ -276,6 +292,9 @@ class SloEngine:
                     "ok": ok,
                     "burn_rate": round(burn, 6),
                     "samples": len(verdicts),
+                    "burn_history": [
+                        [round(t, 3), b] for t, b in self._burn_hist[i]
+                    ],
                 }
             )
         self._last = {
@@ -295,7 +314,13 @@ class SloEngine:
             "failing": 0,
             "no_data": len(self.rules),
             "rules": [
-                {"rule": r.raw, "ok": None, "value": None, "burn_rate": 0.0}
+                {
+                    "rule": r.raw,
+                    "ok": None,
+                    "value": None,
+                    "burn_rate": 0.0,
+                    "burn_history": [],
+                }
                 for r in self.rules
             ],
         }

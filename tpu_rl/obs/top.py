@@ -2,9 +2,11 @@
 
 Polls the storage (or colocated) telemetry HTTP server — ``/metrics``
 (Prometheus text), ``/goodput`` (ledger breakdown + straggler top-k) and
-``/slo`` (verdicts) — and renders a terminal view on stdlib curses:
-per-role goodput bars, bucket breakdowns, throughput/MFU, the straggler
-list, and SLO verdicts. Nothing beyond the standard library; point it at
+``/slo`` (verdicts), plus ``/autopilot`` when a pilot is wired — and
+renders a terminal view on stdlib curses: per-role goodput bars, bucket
+breakdowns, throughput/MFU, the straggler list, autopilot replica/worker
+counts with recent actions and per-rule cooldown status, and SLO
+verdicts. Nothing beyond the standard library; point it at
 any fleet with the plane on::
 
     python -m tpu_rl.obs.top --url http://learner-host:9090/metrics
@@ -120,6 +122,7 @@ def build_frame(
     slo_doc: dict | None,
     url: str = DEFAULT_URL,
     width: int = 100,
+    autopilot_doc: dict | None = None,
 ) -> list:
     """The whole dashboard as a list of text lines (pure; golden-tested)."""
     lines = [f"tpu_rl top — {url}  (q quits)", ""]
@@ -171,6 +174,28 @@ def build_frame(
         )
     lines.append("")
 
+    if autopilot_doc is not None:
+        lines.append(
+            f"AUTOPILOT  replicas {autopilot_doc.get('replicas', '—')}"
+            f"/{autopilot_doc.get('replica_capacity', '—')}"
+            f"  workers {autopilot_doc.get('workers', '—')}"
+            f"  actions {(autopilot_doc.get('counts') or {}).get('actions', 0)}"
+        )
+        actions = autopilot_doc.get("actions") or []
+        if not actions:
+            lines.append("  no actions yet")
+        for a in actions[-5:]:
+            lines.append(
+                f"  {a.get('action', '?'):<10} {a.get('target', '?'):<9}"
+                f" {a.get('from', '?')}->{a.get('to', '?')}"
+                f"  {a.get('reason', '')}"
+            )
+        cooldowns = autopilot_doc.get("cooldowns") or {}
+        for rule, remaining in sorted(cooldowns.items()):
+            state = "armed" if remaining <= 0 else f"cooldown {remaining:.1f}s"
+            lines.append(f"  [{state:>14}] {rule}")
+        lines.append("")
+
     if slo_doc is not None:
         ok = slo_doc.get("ok")
         verdict = "PASS" if ok else ("no data" if ok is None else "FAIL")
@@ -191,14 +216,19 @@ def build_frame(
 
 
 def collect(url: str, timeout: float = 2.0):
-    """Fetch all three endpoints once → (samples, goodput, slo, ok)."""
+    """Fetch all four endpoints once → (samples, goodput, slo, autopilot,
+    ok). ``/autopilot`` is None on fleets without the pilot wired (the
+    endpoint 404s with a JSON error body — filtered here)."""
     base = url.rsplit("/", 1)[0] if url.endswith("/metrics") else url
     status, body = fetch(url, timeout)
     ok = status == 200
     samples = parse_prometheus(body) if ok else []
     goodput_doc = fetch_json(base + "/goodput", timeout)
     slo_doc = fetch_json(base + "/slo", timeout)
-    return samples, goodput_doc, slo_doc, ok
+    autopilot_doc = fetch_json(base + "/autopilot", timeout)
+    if isinstance(autopilot_doc, dict) and "error" in autopilot_doc:
+        autopilot_doc = None
+    return samples, goodput_doc, slo_doc, autopilot_doc, ok
 
 
 # ----------------------------------------------------------------- curses
@@ -224,8 +254,12 @@ def _loop(stdscr, args) -> int:
         pass
     stdscr.timeout(int(args.interval * 1000))
     while True:
-        samples, goodput_doc, slo_doc, ok = collect(args.url, args.timeout)
-        lines = build_frame(samples, goodput_doc, slo_doc, url=args.url)
+        samples, goodput_doc, slo_doc, ap_doc, ok = collect(
+            args.url, args.timeout
+        )
+        lines = build_frame(
+            samples, goodput_doc, slo_doc, url=args.url, autopilot_doc=ap_doc
+        )
         if not ok:
             lines.insert(1, f"  !! /metrics unreachable at {args.url}")
         draw(stdscr, lines)
@@ -249,8 +283,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.once:
-        samples, goodput_doc, slo_doc, ok = collect(args.url, args.timeout)
-        frame = build_frame(samples, goodput_doc, slo_doc, url=args.url)
+        samples, goodput_doc, slo_doc, ap_doc, ok = collect(
+            args.url, args.timeout
+        )
+        frame = build_frame(
+            samples, goodput_doc, slo_doc, url=args.url, autopilot_doc=ap_doc
+        )
         print("\n".join(frame))
         return 0 if ok else 1
 

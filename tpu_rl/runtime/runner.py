@@ -598,3 +598,22 @@ def population_role(
     from tpu_rl.population import PopulationController
 
     return PopulationController(cfg, machines=machines, max_updates=max_updates)
+
+
+def autopilot_role(
+    cfg: Config,
+    machines: MachinesConfig | None = None,
+    manage_all: bool = False,
+    seed: int = 0,
+):
+    """Build the fleet autopilot (``autopilot/controller.py``). Same
+    controller-as-orchestrator shape as ``population_role``: the returned
+    controller runs in the calling process and owns its own supervisor,
+    whose children are the elastic ``inference-<i>`` replicas (and any
+    autopilot-managed workers) it scales in response to the fleet's SLO
+    burn rates, goodput and straggler scores."""
+    from tpu_rl.autopilot import AutopilotController
+
+    return AutopilotController(
+        cfg, machines=machines, manage_all=manage_all, seed=seed
+    )

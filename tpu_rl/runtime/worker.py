@@ -271,15 +271,16 @@ class Worker:
         # Fleet-event totals accumulated the same way across client
         # generations (FleetClient only; 0 forever under a single service).
         fleet_hedges = fleet_failovers = 0
-        fleet_dedups = fleet_floor_rejects = 0
+        fleet_dedups = fleet_floor_rejects = fleet_reprobes = 0
 
         def _fold_fleet(client) -> None:
             nonlocal fleet_hedges, fleet_failovers
-            nonlocal fleet_dedups, fleet_floor_rejects
+            nonlocal fleet_dedups, fleet_floor_rejects, fleet_reprobes
             fleet_hedges += getattr(client, "n_hedges", 0)
             fleet_failovers += getattr(client, "n_failovers", 0)
             fleet_dedups += getattr(client, "n_dedups", 0)
             fleet_floor_rejects += getattr(client, "n_floor_rejects", 0)
+            fleet_reprobes += getattr(client, "n_reprobes", 0)
 
         # Fallback recovery state: when remote acting drops to local, probe
         # the service again every `inference_reprobe_s`, doubling up to
@@ -588,6 +589,10 @@ class Worker:
                         registry.counter("fleet-floor-rejects").set_total(
                             fleet_floor_rejects
                             + getattr(remote, "n_floor_rejects", 0)
+                        )
+                        registry.counter("fleet-reprobes").set_total(
+                            fleet_reprobes
+                            + getattr(remote, "n_reprobes", 0)
                         )
                     if chaos is not None:
                         registry.counter(
