@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
 	resume-smoke slo-smoke loadgen-smoke serving-smoke heal-smoke \
-	pbt-smoke goodput-smoke autopilot-smoke ci
+	pbt-smoke goodput-smoke autopilot-smoke sebulba-smoke ci
 
 lint:
 	ruff check .
@@ -146,7 +146,14 @@ goodput-smoke:
 autopilot-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/autopilot_smoke.py
 
+# Pod-scale colocated smoke (ISSUE 18): 2 virtual hosts train the fused
+# pod-Anakin CartPole to the learning bar with a SIGKILL + rejoin (epoch
+# bump, newest-committed resume, final checkpoint readable), then the
+# sebulba split proves actor/learner overlap through the bounded queue.
+sebulba-smoke:
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/sebulba_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
 	loadgen-smoke serving-smoke heal-smoke pbt-smoke goodput-smoke \
-	autopilot-smoke
+	autopilot-smoke sebulba-smoke

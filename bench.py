@@ -1647,13 +1647,20 @@ def colocated_row(
     jax.block_until_ready(metrics)
     elapsed = time.perf_counter() - t0
     transitions = updates * n_envs * seq_len
+    tps = transitions / elapsed
+    # Topology honesty (ISSUE 18): a colocated number is meaningless without
+    # the device count behind it — pod rows must be read per-device.
+    n_dev = jax.device_count()
     return dict(
         device_kind=jax.devices()[0].device_kind,
+        devices=n_dev,
+        num_processes=jax.process_count(),
         mode="colocated", algo=algo, env=env,
         n_envs=n_envs, seq=seq_len, hidden=hidden_size,
         updates=updates, seconds=round(elapsed, 2),
         iter_ms=round(elapsed / updates * 1e3, 3),
-        colocated_tps=round(transitions / elapsed, 1),
+        colocated_tps=round(tps, 1),
+        tps_per_device=round(tps / n_dev, 1),
         updates_per_s=round(updates / elapsed, 1),
     )
 
@@ -1725,6 +1732,157 @@ def run_colocated_compare(
         return result
     if out_path is None:
         out_path = "bench_colocated.cpu.json" if on_cpu else "bench_colocated.json"
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
+def _mh_colocated_child() -> None:
+    """Virtual-host body for :func:`run_colocated_multihost`. Runs in a
+    fresh process whose ``XLA_FLAGS`` (device count) and gloo coordinator
+    params arrive via ``TPU_RL_BENCH_COLOCATED_MH_CHILD`` (a JSON dict) —
+    both must be set before jax initializes, hence a subprocess, never a
+    fork of this process. Prints one JSON row from the chief."""
+    p = json.loads(os.environ["TPU_RL_BENCH_COLOCATED_MH_CHILD"])
+    from tpu_rl.config import Config
+    from tpu_rl.parallel.dp import replicate
+    from tpu_rl.runtime.colocated import ColocatedLoop
+
+    nhosts, ndev = int(p["nhosts"]), int(p["ndev"])
+    mh = None
+    if nhosts > 1:
+        mh = {
+            "coordinator": f"127.0.0.1:{p['port']}",
+            "num_processes": nhosts,
+            "process_id": int(p["pid"]),
+        }
+    cfg = Config.from_dict(
+        dict(
+            env="CartPole-v1", env_mode="colocated", algo="IMPALA",
+            batch_size=int(p["n_envs"]), buffer_size=int(p["n_envs"]),
+            seq_len=5, hidden_size=64, loss_log_interval=10**9,
+            mesh_data=nhosts * ndev, multihost=mh,
+        )
+    )
+    loop = ColocatedLoop(cfg, seed=0)
+    state = replicate(loop.state, loop.mesh)
+    carry = loop.init_carry(jax.random.PRNGKey(1))
+    stats = loop.init_stats()
+    updates, warmup = int(p["updates"]), int(p["warmup"])
+    metrics = None
+    for i in range(warmup + updates):
+        if i == warmup:
+            jax.block_until_ready(metrics)
+            t0 = time.perf_counter()
+        k_roll, k_train = jax.random.split(jax.random.fold_in(loop._k_base, i))
+        state, carry, stats, metrics = loop.program(
+            state, carry, stats, k_roll, k_train
+        )
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+    if jax.process_index() == 0:
+        tps = updates * int(p["n_envs"]) * 5 / elapsed
+        n_dev = jax.device_count()
+        print(json.dumps(dict(
+            device_kind=jax.devices()[0].device_kind,
+            num_processes=jax.process_count(), devices=n_dev,
+            n_envs=int(p["n_envs"]), updates=updates,
+            seconds=round(elapsed, 2),
+            colocated_tps=round(tps, 1),
+            tps_per_device=round(tps / n_dev, 1),
+        )), flush=True)
+
+
+def _mh_colocated_row(
+    nhosts: int, ndev: int, envs_per_device: int, updates: int,
+    warmup: int, port: int,
+) -> dict:
+    """One pod-Anakin scaling row: ``nhosts`` subprocess virtual hosts with
+    ``ndev`` CPU devices each, SAME per-device env batch (the weak-scaling
+    shape: global envs = envs_per_device x nhosts x ndev)."""
+    import subprocess
+
+    n_envs = envs_per_device * nhosts * ndev
+    procs = []
+    for pid in range(nhosts):
+        env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
+        env["TPU_RL_BENCH_COLOCATED_MH_CHILD"] = json.dumps(dict(
+            pid=pid, nhosts=nhosts, ndev=ndev, port=port,
+            n_envs=n_envs, updates=updates, warmup=warmup,
+        ))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env,
+        ))
+    outs = [p.communicate(timeout=900)[0] for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"virtual host {pid}/{nhosts} rc={p.returncode}\n{out[-3000:]}"
+        )
+    row = json.loads(outs[0].strip().splitlines()[-1])
+    row["envs_per_device"] = envs_per_device
+    return row
+
+
+def run_colocated_multihost(out_path: str | None = None) -> dict:
+    """Pod-Anakin weak-scaling A/B (ISSUE 18): the fused colocated program
+    on 1 vs 2 virtual hosts (subprocess ``jax.distributed`` + gloo, 1 CPU
+    device per host) at the SAME per-device env batch. Ideal scaling is 2x
+    global transitions/s; the acceptance bar (>= 1.8x) only applies where
+    the hosts have real parallel hardware — the record keeps ``host_cores``
+    and ``oversubscribed`` so a 1-core CI box's timesharing numbers can
+    never be read as a scaling regression.
+
+    ``TPU_RL_BENCH_COLOCATED_MH_LIGHT=1`` is the smoke shape: short
+    windows, no result file.
+    """
+    on_cpu = jax.devices()[0].platform == "cpu"
+    light = bool(os.environ.get("TPU_RL_BENCH_COLOCATED_MH_LIGHT"))
+    updates = 20 if light else 120
+    warmup = 3 if light else 5
+    envs_per_device = 64
+    ndev = 1
+    rows = []
+    for i, nhosts in enumerate((1, 2)):
+        row = _mh_colocated_row(
+            nhosts, ndev, envs_per_device, updates, warmup,
+            port=29960 + 2 * i,
+        )
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    host_cores = os.cpu_count() or 1
+    total_devices = 2 * ndev
+    oversubscribed = on_cpu and total_devices > host_cores
+    scaling = round(rows[1]["colocated_tps"] / rows[0]["colocated_tps"], 2)
+    result = {
+        "metric": "pod-Anakin colocated weak scaling, 1 vs 2 virtual hosts, "
+                  "transitions/s at fixed per-device env batch",
+        "device_kind": rows[0]["device_kind"],
+        "scaling_2x_vs_1x": scaling,
+        "tps_1host": rows[0]["colocated_tps"],
+        "tps_2host": rows[1]["colocated_tps"],
+        "tps_per_device_1host": rows[0]["tps_per_device"],
+        "tps_per_device_2host": rows[1]["tps_per_device"],
+        "envs_per_device": envs_per_device,
+        "host_cores": host_cores,
+        "oversubscribed": oversubscribed,
+        "light": light,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    if not oversubscribed:
+        # The real acceptance bar — only meaningful with parallel hardware.
+        assert scaling >= 1.8, f"pod scaling below bar: {result}"
+    if light:
+        return result
+    if out_path is None:
+        out_path = (
+            "bench_colocated_multihost.cpu.json" if on_cpu
+            else "bench_colocated_multihost.json"
+        )
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     return result
@@ -1822,6 +1980,18 @@ def last_relay_record(path: str | None = None) -> dict | None:
 
 
 if __name__ == "__main__":
+    if os.environ.get("TPU_RL_BENCH_COLOCATED_MH_CHILD"):
+        # Virtual-host body spawned by run_colocated_multihost — must be
+        # dispatched before anything queries devices (its XLA_FLAGS device
+        # count and distributed-runtime params came in via the environment).
+        _mh_colocated_child()
+        sys.exit(0)
+    if os.environ.get("TPU_RL_BENCH_COLOCATED_MH"):
+        # Pod-Anakin scaling A/B: the fused colocated program on 1 vs 2
+        # virtual hosts at the same per-device env batch (ISSUE 18).
+        # TPU_RL_BENCH_COLOCATED_MH_LIGHT=1 is the smoke shape.
+        print(json.dumps(run_colocated_multihost()))
+        sys.exit(0)
     if os.environ.get("TPU_RL_BENCH_COLOCATED"):
         # Colocated (Anakin) A/B mode: fused on-device act->step->train vs
         # the distributed storage->learner feed, on whatever backend jax

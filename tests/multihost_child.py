@@ -32,14 +32,16 @@ def main() -> None:
     port = sys.argv[2]
     nprocs = int(sys.argv[3]) if len(sys.argv) > 3 else 2
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # 2 virtual CPU devices per process. Must be an XLA flag set before jax
+    # imports (the parent strips any inherited XLA_FLAGS): this jax version
+    # has no jax_num_cpu_devices config option.
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 
     import jax
 
     # The TPU plugin here ignores JAX_PLATFORMS (see tpu_rl.utils.platform);
-    # config-force the CPU platform with 2 local devices BEFORE the
-    # distributed runtime starts.
+    # config-force the CPU platform BEFORE the distributed runtime starts.
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
 
     from tpu_rl.parallel.multihost import init_multihost, is_multihost
 

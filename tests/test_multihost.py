@@ -59,11 +59,17 @@ def _run_children(nprocs: int, port: int) -> None:
         assert "MULTIHOST_CHILD_OK" in outs[i], outs[i][-3000:]
 
 
+# slow: each spins up a full subprocess pod (jax.distributed + gloo) on
+# this one-core box — ~30s/~70s wall. The tier-1 multihost gate is the
+# cheaper fused-pod coverage; these run in the slow tier with
+# tests/test_colocated_multihost.py.
+@pytest.mark.slow
 @pytest.mark.timeout(420)
 def test_two_process_distributed_runtime():
     _run_children(2, 29950)
 
 
+@pytest.mark.slow
 @pytest.mark.timeout(420)
 def test_four_process_distributed_runtime():
     _run_children(4, 29954)

@@ -125,6 +125,10 @@ _SAMPLE_RE = re.compile(
 )
 
 
+# slow: boots a full supervised cluster (~85s on this one-core box) and
+# the same surface is gated in CI by `make obs-smoke`/`make trace-smoke`;
+# the tier-1 budget goes to the unit-level obs tests.
+@pytest.mark.slow
 @pytest.mark.timeout(300)
 def test_cluster_telemetry_scrape_end_to_end(tmp_path):
     """Acceptance: boot the full local cluster with the plane on, scrape

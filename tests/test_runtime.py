@@ -115,6 +115,11 @@ def test_remote_acting_cluster_end_to_end(tmp_path):
         sup.stop()
 
 
+# slow: a full off-policy cluster run (~80s on this one-core box). The
+# replay path stays tier-1-covered by test_train_inline's replay test and
+# test_shm_ring_mp's torn-slot sampler tests; the on-policy cluster e2e
+# tests below keep the supervised-runtime surface in the fast gate.
+@pytest.mark.slow
 @pytest.mark.timeout(300)
 def test_sac_replay_cluster_end_to_end(tmp_path):
     """Off-policy path as real processes: worker rollouts -> manager ->

@@ -9,7 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from tpu_rl.parallel.mesh import shard_map
 
 from tpu_rl.parallel.sequence import (
     SEQ_AXIS,

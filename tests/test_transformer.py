@@ -94,6 +94,11 @@ class TestTransformerUnroll:
 
 
 class TestSequenceParallelTrainStep:
+    # slow: every case compiles a fresh (data=2, seq=4) shard_map train
+    # step on 8 virtual devices (~8-10s each on this box); tier-1 keeps
+    # the cheap SP validation test, the grad-equivalence matrix runs in
+    # the slow tier.
+    @pytest.mark.slow
     @pytest.mark.parametrize(
         "impl,algo",
         [("ring", "PPO"), ("ulysses", "PPO"), ("ring", "V-MPO")],
@@ -139,6 +144,7 @@ class TestSequenceParallelTrainStep:
                 np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-4
             )
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     def test_bf16_sp_train_step_runs(self, devices, rng, impl):
         """bfloat16 compute composes with sharded attention (f32 softmax
@@ -200,6 +206,7 @@ class TestMixedPrecisionStructure:
         mixed = [(a, b) for a, b in dots if a != b]
         assert not mixed, f"mixed-dtype dots: {mixed}"
 
+    @pytest.mark.slow  # traces the full SP shard_map island (~3s)
     def test_bf16_ring_sp_train_step_has_no_mixed_dtype_dots(self, devices):
         """Same invariant through the sequence-parallel path: the ring
         attention shard_map island and its hand-written VJP (whose einsums
@@ -293,6 +300,7 @@ class TestTransformerActing:
             if dtype == "float32":
                 np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
 
+    @pytest.mark.slow  # compiles both acting programs at ctx=256 (~3s)
     def test_kv_cache_is_cheaper(self):
         """Compiled FLOPs of one cached acting step must be far below the
         window-recompute step at long context (the point of the redesign)."""
@@ -308,9 +316,10 @@ class TestTransformerActing:
         key = jax.random.key(0)
 
         def flops(fn, h, c):
+            from tpu_rl.obs.perf import compiled_flops
+
             lowered = jax.jit(fn).lower(params, obs, h, c, key)
-            cost = lowered.compile().cost_analysis()
-            return cost.get("flops", 0.0) if cost else 0.0
+            return compiled_flops(lowered.compile())
 
         f_kv = flops(
             fam.act,

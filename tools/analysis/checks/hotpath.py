@@ -73,6 +73,13 @@ MANIFEST: dict[str, dict[str, str]] = {
     "tpu_rl/runtime/worker.py": {
         "Worker.run": FMT,
     },
+    "tpu_rl/runtime/sebulba.py": {
+        # The lane seam: both sides cross it once per produced batch, and
+        # any blocking inside is *measured* (queue-wait) — allocation here
+        # would pollute the backpressure signal itself.
+        "BoundedPipe.put": STRICT,
+        "BoundedPipe.get": STRICT,
+    },
 }
 
 # Helpers whose call is an allocation/serialization bomb regardless of tier.

@@ -36,6 +36,7 @@ NAME = "jit"
 # Files/dirs holding jit or scan bodies (repo-relative).
 TARGETS = (
     "tpu_rl/runtime/colocated.py",
+    "tpu_rl/runtime/sebulba.py",
     "tpu_rl/runtime/inference_service.py",
     "tpu_rl/runtime/learner_service.py",
     "tpu_rl/runtime/worker.py",

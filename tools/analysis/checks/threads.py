@@ -40,6 +40,11 @@ INVENTORY: dict[str, dict[str, frozenset[str]]] = {
         # Every shared write happens under self._cond by construction.
         "Checkpointer._run": frozenset(),
     },
+    "tpu_rl/runtime/sebulba.py": {
+        # Actor lane: publication is the BoundedPipe plus the params/stats
+        # slots, and every slot write sits under self._lane_lock.
+        "SebulbaLoop._actor_loop": frozenset(),
+    },
     "tpu_rl/runtime/inference_service.py": {
         # _jnp: imported once at thread start, read-only afterwards.
         # error: single-writer slot; the runner reads it post-join.

@@ -47,6 +47,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--colocated-envs", type=int, default=None,
                    help="env-batch size for colocated mode (overrides "
                    "batch_size there; 0/unset = batch_size)")
+    p.add_argument("--sebulba-split", type=int, default=None,
+                   help="colocated mode: dedicate this many local devices "
+                   "to the rollout program (actor group); the rest run "
+                   "train_step, fed through a bounded on-device queue "
+                   "(Podracer Sebulba). 0/unset = fused Anakin")
+    p.add_argument("--sebulba-queue", type=int, default=None,
+                   help="bounded device-resident batch slots between the "
+                   "sebulba device groups (2 = double buffering)")
     p.add_argument("--mesh-data", type=int, help="learner data-mesh size")
     p.add_argument("--act-mode", choices=["local", "remote"], default=None,
                    help="'remote' routes worker acting through the "
@@ -177,6 +185,10 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["env_mode"] = args.env_mode
     if args.colocated_envs is not None:
         overrides["colocated_envs"] = args.colocated_envs
+    if args.sebulba_split is not None:
+        overrides["sebulba_split"] = args.sebulba_split
+    if args.sebulba_queue is not None:
+        overrides["sebulba_queue"] = args.sebulba_queue
     if args.mesh_data:
         overrides["mesh_data"] = args.mesh_data
     if args.act_mode is not None:

@@ -232,7 +232,22 @@ class Checkpointer:
         self._clean_torn()
         import orbax.checkpoint as ocp
 
-        self._ckpt = ocp.StandardCheckpointer()
+        if jax.process_count() > 1:
+            # Single-writer contract in a multiprocess runtime (pod-Anakin:
+            # the chief saves, every host restores through its own handle).
+            # Default orbax inserts cross-host barriers around every
+            # save/restore, so a chief-gated save would deadlock the pod —
+            # scope the barrier set to this process alone.
+            from orbax.checkpoint import options as ocp_options
+
+            mp = ocp_options.MultiprocessingOptions(
+                primary_host=jax.process_index(),
+                active_processes={jax.process_index()},
+                barrier_sync_key_prefix=f"tpu_rl_p{jax.process_index()}",
+            )
+            self._ckpt = ocp.StandardCheckpointer(multiprocessing_options=mp)
+        else:
+            self._ckpt = ocp.StandardCheckpointer()
         # --- async machinery (idle unless async_save) ---
         self._cond = threading.Condition()
         self._queued: tuple[Any, int, dict] | None = None

@@ -298,6 +298,21 @@ class Config:
     # 0 = use batch_size unchanged. Thousands of instances is the intended
     # operating point on chip; tests/CI run tens.
     colocated_envs: int = 0
+    # Sebulba split (Podracer, tpu_rl.runtime.sebulba): number of THIS
+    # host's devices dedicated to the jitted act->env.step rollout program;
+    # the REMAINING local devices run train_step, fed through a bounded
+    # on-device queue so acting overlaps training instead of serializing
+    # inside one fused dispatch. 0 = off (pure Anakin: one fused program
+    # over one mesh). Requires env_mode="colocated"; the split must
+    # partition jax.local_device_count() into two non-empty groups —
+    # checked at loop construction (config never imports jax).
+    sebulba_split: int = 0
+    # Bounded device-resident Batch slots between the device groups (2 =
+    # double buffering, 3 = triple). Bounds learner-group staging memory
+    # AND policy staleness (a queued batch is at most depth+1 updates
+    # stale); a full queue backpressures the actor into the goodput
+    # ledger's queue-wait bucket.
+    sebulba_queue: int = 2
     # RolloutAssembler idle-trajectory drop window, seconds
     # (reference hard-codes 0.5: /root/reference/buffers/rollout_assembler.py:52-56).
     rollout_lag_sec: float = 0.5
@@ -666,6 +681,30 @@ class Config:
             assert not self.need_conv, (
                 "colocated mode has no image-env dynamics (tpu_rl.envs)"
             )
+            if self.multihost:
+                # Static half of the pod divisibility contract: the env
+                # batch shards over the global data axis, so it must at
+                # least divide by the process count (the full per-device
+                # check needs jax.device_count() and runs in ColocatedLoop).
+                nproc = int(self.multihost.get("num_processes", 1))
+                envs = self.colocated_envs or self.batch_size
+                assert nproc >= 1, self.multihost
+                assert envs % nproc == 0, (
+                    f"colocated env batch ({envs}) not divisible by "
+                    f"multihost num_processes ({nproc}) — it shards over "
+                    "the global data axis"
+                )
+        assert self.sebulba_split >= 0, self.sebulba_split
+        assert self.sebulba_queue >= 1, self.sebulba_queue
+        if self.sebulba_split:
+            assert self.env_mode == "colocated", (
+                "sebulba_split splits the colocated plane's device groups; "
+                "set env_mode='colocated'"
+            )
+            assert self.multihost is None, (
+                "sebulba_split is a per-host (single-process) split; "
+                "multihost pod scaling uses the fused Anakin path"
+            )
         assert self.act_mode in ("local", "remote"), self.act_mode
         assert self.relay_mode in ("raw", "decode"), self.relay_mode
         assert self.transport in ("tcp", "shm", "auto"), self.transport
@@ -863,6 +902,9 @@ class Config:
             )
             assert self.multihost is None, (
                 "learner_chain > 1 is not supported with a multihost learner"
+            )
+            assert self.sebulba_split == 0, (
+                "learner_chain > 1 is not supported with a sebulba split"
             )
         if self.sac_reference_alpha and self.target_entropy is not None:
             # The parity branch takes precedence in algos/sac.py; silently

@@ -196,13 +196,13 @@ class LSTMCell(nn.Module):
             if mesh is not None and n_data > 1:
                 from jax.sharding import PartitionSpec as P
 
-                from tpu_rl.parallel.mesh import DATA_AXIS
+                from tpu_rl.parallel.mesh import DATA_AXIS, shard_map
 
                 def _local_unroll(xp_, wh_, h0_, c0_, keep_):
                     return lstm_unroll(xp_, wh_, h0_, c0_, keep_, interpret)
 
                 bspec = P(DATA_AXIS)  # shard every operand's leading (batch) dim
-                hs, cs = jax.shard_map(
+                hs, cs = shard_map(
                     _local_unroll,
                     mesh=mesh,
                     in_specs=(bspec, P(), bspec, bspec, bspec),

@@ -28,20 +28,36 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+_INITIALIZED = False
+
 
 def init_multihost(
     coordinator: str, num_processes: int, process_id: int, **kw
 ) -> None:
     """Bring this host into the JAX distributed runtime. Must run before any
-    other JAX call in the process. No-op when num_processes == 1."""
-    if num_processes <= 1:
+    other JAX call in the process. No-op when num_processes == 1, and
+    idempotent within a process (roles construct their loop objects more
+    than once in tests)."""
+    global _INITIALIZED
+    if num_processes <= 1 or _INITIALIZED:
         return
+    # The CPU backend has no default cross-process collective implementation:
+    # without one, any multi-process jit fails at dispatch with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Selecting gloo here makes CPU pods (tests, virtual-host CI meshes)
+    # work; TPU/GPU backends route collectives over ICI/DCN and never read
+    # this option. Guarded for jax versions that predate the knob.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
         process_id=process_id,
         **kw,
     )
+    _INITIALIZED = True
 
 
 def is_multihost() -> bool:

@@ -677,8 +677,10 @@ def flash_attention_tpu(
     if mesh_tiles:
         from jax.sharding import PartitionSpec as P
 
+        from tpu_rl.parallel.mesh import shard_map
+
         qs = P(DATA_AXIS, None, None, None)
-        return jax.shard_map(
+        return shard_map(
             kernel,
             mesh=mesh,
             in_specs=(qs, qs, qs, P(DATA_AXIS, None)),

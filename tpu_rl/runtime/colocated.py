@@ -110,8 +110,18 @@ class ColocatedLoop:
         self._stop = stop_event
         self._heartbeat = heartbeat
 
-        self.mesh = make_mesh(cfg.mesh_data)
-        check_divisible(cfg.batch_size, self.mesh)
+        # Pod-Anakin: join the jax.distributed runtime BEFORE any device
+        # query, exactly like the learner role (learner_service.run). After
+        # init the meshes below span every host's chips and GSPMD inserts
+        # the cross-host gradient all-reduce into the unchanged fused
+        # program. Single-host configs (multihost=None or num_processes=1)
+        # skip this entirely.
+        if cfg.multihost:
+            from tpu_rl.parallel.multihost import init_multihost
+
+            init_multihost(**cfg.multihost)
+        self._chief = jax.process_index() == 0
+        self._build_meshes()
         self.spec = get_spec(cfg.env)
         self._v_reset, self._v_step = make_vec_env(
             self.spec, cfg.batch_size, cfg.time_horizon
@@ -148,22 +158,7 @@ class ColocatedLoop:
             )
             self._fingerprint = resume_fingerprint(cfg)
 
-        rs, bs = replicated(self.mesh), batch_sharding(self.mesh)
-        self._rs, self._bs = rs, bs
-        # Every rollout output is batch-leading, so one sharding prefix
-        # covers carry, batch, done and ret alike.
-        self.rollout = jax.jit(
-            self._rollout_body,
-            in_shardings=(rs, bs, rs),
-            out_shardings=bs,
-            donate_argnums=(1,),
-        )
-        self.program = jax.jit(
-            self._program_body,
-            in_shardings=(rs, bs, rs, rs, rs),
-            out_shardings=(rs, bs, rs, rs),
-            donate_argnums=(0, 1, 2),
-        )
+        self._compile()
 
         # Telemetry plane (same knobs/ports as every other role; satellite of
         # the obs registry — nothing is constructed when the plane is off).
@@ -179,6 +174,54 @@ class ColocatedLoop:
         # everything else (telemetry, logging) spills into overhead.
         self.ledger = None
         self._setup_telemetry()
+
+    # ---------------------------------------------------------- topology hooks
+    def _build_meshes(self) -> None:
+        """Device-topology hook: the Anakin loop is ONE mesh for acting and
+        training alike (the sebulba subclass splits them). Under multihost
+        the default ``mesh_data=1`` widens to the full global device set —
+        a pod run saying nothing about mesh width means "use the pod"."""
+        cfg = self.cfg
+        if cfg.multihost and jax.process_count() > 1 and cfg.mesh_data == 1:
+            self.mesh = make_mesh(jax.device_count())
+        else:
+            self.mesh = make_mesh(cfg.mesh_data)
+        self.act_mesh = self.mesh
+        check_divisible(cfg.batch_size, self.mesh)
+
+    def _compile(self) -> None:
+        """Compile hook: build the jitted entry points for this topology."""
+        rs, bs = replicated(self.mesh), batch_sharding(self.mesh)
+        self._rs, self._bs = rs, bs
+        # Acting-side shardings: identical to the train mesh here; the
+        # sebulba split points them at the actor device group instead.
+        self._act_rs, self._act_bs = rs, bs
+        # Every rollout output is batch-leading, so one sharding prefix
+        # covers carry, batch, done and ret alike.
+        self.rollout = jax.jit(
+            self._rollout_body,
+            in_shardings=(rs, bs, rs),
+            out_shardings=bs,
+            donate_argnums=(1,),
+        )
+        self.program = jax.jit(
+            self._program_body,
+            in_shardings=(rs, bs, rs, rs, rs),
+            out_shardings=(rs, bs, rs, rs),
+            donate_argnums=(0, 1, 2),
+        )
+
+    def _place(self, tree, sharding):
+        """Put host-built (or locally-committed) arrays under a global
+        sharding. Single-process meshes take the direct ``device_put``;
+        multi-process meshes route through an SPMD identity jit (same trick
+        as ``parallel.dp.replicate`` — ``device_put`` refuses shardings that
+        span non-addressable devices). Valid because every host builds the
+        identical value (same seed/key stream)."""
+        local = jax.process_index()
+        if all(d.process_index == local for d in sharding.mesh.devices.flat):
+            return jax.device_put(tree, sharding)
+        return jax.jit(lambda t: t, out_shardings=sharding)(tree)
 
     # ------------------------------------------------------------ device init
     def init_carry(self, key: jax.Array) -> dict:
@@ -197,15 +240,15 @@ class ColocatedLoop:
             "is_fir": jnp.ones((n,), jnp.float32),
             "ret": jnp.zeros((n,), jnp.float32),
         }
-        return jax.device_put(carry, self._bs)
+        return self._place(carry, self._act_bs)
 
     def init_stats(self) -> dict:
-        return jax.device_put(
+        return self._place(
             {
                 "episodes": jnp.zeros((), jnp.int32),
                 "ret_sum": jnp.zeros((), jnp.float32),
             },
-            self._rs,
+            self._act_rs,
         )
 
     # -------------------------------------------------------------- jit bodies
@@ -366,8 +409,8 @@ class ColocatedLoop:
             rss, n_fds = process_self_stats()
             reg.gauge("colocated-rss-bytes").set(rss)
             reg.gauge("colocated-open-fds").set(float(n_fds))
-        if self.ledger is not None:
-            self.ledger.publish(reg)
+        for led in self._ledgers():
+            led.publish(reg)
         if self._slo is not None:
             self._slo.evaluate(self.aggregator)
         if self._json_exp is not None and self._json_exp.maybe_export():
@@ -380,6 +423,11 @@ class ColocatedLoop:
                     self.cfg.result_dir, "goodput.jsonl",
                     self._goodput_payload(),
                 )
+
+    def _ledgers(self) -> list:
+        """Every goodput ledger this loop owns (one per lane thread; the
+        fused Anakin loop is one lane, the sebulba split is two)."""
+        return [self.ledger] if self.ledger is not None else []
 
     def _goodput_payload(self) -> dict:
         """The GET /goodput document for the single-process deployment: just
@@ -433,6 +481,10 @@ class ColocatedLoop:
         """Drive the fused program to ``max_updates`` (or until the stop
         event). Returns a summary dict with run totals and timer scalars."""
         cfg = self.cfg
+        # Non-chief pod processes run the identical SPMD program but leave
+        # stdout and checkpoint writes to process 0 (the restore below runs
+        # everywhere — model_dir is shared storage on a pod).
+        log = log and self._chief
         n, s = cfg.batch_size, cfg.seq_len
         timer = ExecutionTimer(num_transition=n * s)
         from tpu_rl.utils.metrics import make_writer
@@ -492,7 +544,11 @@ class ColocatedLoop:
             it += 1
             if self._heartbeat is not None:
                 self._heartbeat.value = time.time()
-            if self.ckpt is not None and it % cfg.model_save_interval == 0:
+            if (
+                self.ckpt is not None
+                and self._chief
+                and it % cfg.model_save_interval == 0
+            ):
                 # `state` is the program's fresh output buffers (donation
                 # consumes the inputs), so the save path may snapshot it.
                 t_ck = time.perf_counter()
@@ -553,6 +609,7 @@ class ColocatedLoop:
         elapsed = time.perf_counter() - t0
         if (
             self.ckpt is not None
+            and self._chief
             and it > self._start_it
             and it != self._last_saved
         ):
@@ -574,6 +631,9 @@ class ColocatedLoop:
         writer.flush()
         writer.close()
         self.close()
+        # Expose the final device state: the donated input handles are dead,
+        # and tests/parity probes read params from here after run().
+        self.state = state
         episodes = int(host_stats["episodes"])
         ret_sum = float(host_stats["ret_sum"])
         new_it = it - self._start_it

@@ -536,18 +536,26 @@ def colocated_role(
     max_updates: int | None = None,
     seed: int = 0,
 ) -> Supervisor:
-    """Spawn the fused Anakin-mode loop (``runtime/colocated.py``): envs live
-    on the accelerator inside the jitted train program, so the whole
-    deployment is ONE supervised child — no storage, manager or workers.
-    ``machines`` is accepted (and ignored) so the CLI can dispatch every role
-    through one signature."""
+    """Spawn the colocated-mode loop (``runtime/colocated.py``): envs live
+    on the accelerator inside the jitted train program, so this host's
+    whole deployment is ONE supervised child — no storage, manager or
+    workers. Routing: ``cfg.sebulba_split > 0`` spawns the split
+    actor/learner-group loop (``runtime/sebulba.py``), otherwise the fused
+    Anakin program; ``cfg.multihost`` is honored either way — the child
+    joins the jax.distributed runtime exactly like the learner role, one
+    ``colocated_role`` invocation per pod host. ``machines`` is accepted
+    (and ignored) so the CLI can dispatch every role through one
+    signature."""
     del machines  # colocated mode has no fleet topology
-    from tpu_rl.runtime.colocated import colocated_main
+    if cfg.sebulba_split > 0:
+        from tpu_rl.runtime.sebulba import sebulba_main as child_main
+    else:
+        from tpu_rl.runtime.colocated import colocated_main as child_main
 
     sup = supervisor or Supervisor.from_config(cfg)
     sup.spawn(
         "colocated",
-        functools.partial(colocated_main, max_updates=max_updates, seed=seed),
+        functools.partial(child_main, max_updates=max_updates, seed=seed),
         cfg,
         # "auto": the fused program owns the accelerator. "cpu": force the
         # CPU backend (CI, or when another process holds the chip).
