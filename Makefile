@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
-	relay-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
+	relay-smoke diag-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
 	resume-smoke slo-smoke loadgen-smoke serving-smoke heal-smoke \
 	pbt-smoke goodput-smoke autopilot-smoke sebulba-smoke ci
 
@@ -65,6 +65,15 @@ relay-smoke:
 	JAX_PLATFORMS=cpu TPU_RL_BENCH_RELAY=1 TPU_RL_BENCH_RELAY_LIGHT=1 \
 		$(PY) bench.py > /dev/null
 
+# Learning-dynamics plane smoke: the chained train step with learn_diag on
+# vs off at a tiny budget. Asserts sanity only (no catastrophic overhead —
+# a host sync sneaking into the step reads as 2x, not 2%) — never the
+# committed <=2% number, so CI load can't make it flap. Full capture:
+# TPU_RL_BENCH_DIAG=1 python bench.py  (writes bench_diag[.cpu].json).
+diag-smoke:
+	JAX_PLATFORMS=cpu TPU_RL_BENCH_DIAG=1 TPU_RL_BENCH_DIAG_LIGHT=1 \
+		$(PY) bench.py > /dev/null
+
 # Telemetry-plane smoke: boot the smallest real cluster with the plane on,
 # scrape /metrics + /healthz mid-run, validate telemetry.json + trace.json.
 obs-smoke:
@@ -99,8 +108,9 @@ resume-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/resume_smoke.py
 
 # SLO-plane smoke: the same small cluster twice under Config.slo_spec — a
-# meetable three-rule spec must scrape green on /slo and exit 0; adding an
-# impossible rule with slo_fail_run armed must scrape 503 and exit nonzero.
+# meetable six-rule spec (system health + learner-diag training health)
+# must scrape green on /slo and exit 0; adding an impossible rule with
+# slo_fail_run armed must scrape 503 and exit nonzero.
 slo-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/slo_smoke.py
 
@@ -153,7 +163,7 @@ autopilot-smoke:
 sebulba-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/sebulba_smoke.py
 
-ci: lint analyze typecheck test protocol-matrix relay-smoke obs-smoke \
+ci: lint analyze typecheck test protocol-matrix relay-smoke diag-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
 	loadgen-smoke serving-smoke heal-smoke pbt-smoke goodput-smoke \
 	autopilot-smoke sebulba-smoke

@@ -23,17 +23,23 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Four rules over metrics every distributed run produces: a tail-latency
+# Six rules over metrics every distributed run produces: a tail-latency
 # bound (staleness histogram, in updates), a worst-case resource gauge, a
 # fleet-summed failure rate (the ISSUE's example rule — no corruption is
-# injected here, so the rate must hold at 0/s), and an exact invariant —
+# injected here, so the rate must hold at 0/s), an exact invariant —
 # the in-jit update guards are on by default, so a clean run must apply
-# every update (any skipped-nonfinite update is a violation, not a budget).
+# every update (any skipped-nonfinite update is a violation, not a budget) —
+# and two training-health rules over the learning-dynamics plane
+# (``learn_diag``, on by default): a discrete policy that has not collapsed
+# keeps positive entropy, and a trust-region-clipped PPO update keeps
+# approx-KL well under 1 nat.
 PASSING_SPEC = (
     "p99:policy-staleness-updates<10000,"
     "gauge:storage-rss-bytes>0,"
     "rate:transport-rejected-frames<1/s,"
-    "counter:learner-nonfinite-updates==0"
+    "counter:learner-nonfinite-updates==0,"
+    "gauge:learner-diag-entropy>0,"
+    "gauge:learner-diag-approx-kl<1.0"
 )
 # A live storage process can never hold under one byte of RSS.
 IMPOSSIBLE_RULE = "gauge:storage-rss-bytes<1"

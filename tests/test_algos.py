@@ -59,8 +59,14 @@ def test_train_step_runs_and_updates(algo):
     step = jax.jit(train_step)
 
     s1, metrics = step(state, batch, jax.random.PRNGKey(1))
+    # The learning-dynamics pytree rides in metrics["diag"] (nested; popped
+    # by every runtime loop before scalar logging) — every leaf must be
+    # finite, like the scalars.
+    diag = metrics.pop("diag")
     for k, v in metrics.items():
         assert np.isfinite(float(v)), (k, v)
+    for leaf in jax.tree_util.tree_leaves(diag):
+        assert np.all(np.isfinite(np.asarray(leaf))), diag
     assert int(s1.step) == 1
 
     if spec.on_policy:
@@ -146,6 +152,7 @@ def test_vmpo_stays_finite_under_extreme_ratios():
     step = jax.jit(train_step)
     for i in range(3):
         state, m = step(state, batch, jax.random.PRNGKey(10 + i))
+    m.pop("diag", None)
     for k, v in m.items():
         assert np.isfinite(float(v)), (k, v)
     for leaf in jax.tree_util.tree_leaves(state.params):

@@ -78,6 +78,11 @@ def test_run_all_cpu_headline_carries_stale_onchip(tmp_path, monkeypatch):
                                 "step_ms": 1.0, "mfu": None,
                                 "steps_per_call": 1},
     )
+    # The live-plane agreement sections spin real jitted learners (~30s on
+    # a CI core each run_all call) and are not this test's subject — the
+    # headline assembly around them is.
+    monkeypatch.setattr(bench, "perf_crosscheck", lambda: {"stub": True})
+    monkeypatch.setattr(bench, "goodput_crosscheck", lambda: {"stub": True})
     stale = {"recorded_at": "2026-07-31T16:21:00Z",
              "device_kind": "TPU v5 lite", "headline_tps": 5_320_000.0,
              "vs_baseline": 8866.67, "rows": []}
@@ -138,3 +143,38 @@ def test_committed_multihost_scaling_record():
     assert rec["host_cores"] >= 1
     if not rec["oversubscribed"]:
         assert rec["scaling_2x_vs_1x"] >= 1.8, rec
+
+
+def test_committed_diag_overhead_record():
+    """The committed learning-dynamics diag A/B record (ISSUE 19,
+    ``run_diag_compare``) must parse with the full schema — per-algo
+    on/off step times and overhead, the 2% contract value, and the
+    contract_binding flag — and wherever the capture was taken on an
+    accelerator (binding regime), the <=2% bar must actually hold. CPU
+    captures record the numbers but a 1-core CI box's timer noise exceeds
+    the bar, so there the check is sanity-level only (no host sync snuck
+    into the step: overheads stay far from 2x)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(bench.__file__)),
+        "bench_diag.cpu.json",
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    for key in (
+        "metric", "device_kind", "chain", "repeats", "max_overhead_pct",
+        "contract_pct", "contract_binding", "recorded_at", "rows",
+    ):
+        assert key in rec, f"missing key: {key}"
+    assert rec["contract_pct"] == 2.0
+    algos = [r["algo"] for r in rec["rows"]]
+    # clip/KL, V-trace clip-rate+ESS, and twin-critic/alpha channel shapes
+    assert {"IMPALA", "PPO", "SAC"} <= set(algos)
+    for r in rec["rows"]:
+        assert r["step_ms_diag_on"] > 0 and r["step_ms_diag_off"] > 0
+        assert r["tps_diag_on"] > 0 and r["tps_diag_off"] > 0
+        assert r["overhead_pct"] is not None
+        # sanity bound on every capture regime: a regression that forces a
+        # host readback per update shows up as >2x, not single percents
+        assert r["overhead_pct"] < 50.0, r
+    if rec["contract_binding"]:
+        assert rec["max_overhead_pct"] <= rec["contract_pct"], rec

@@ -201,6 +201,17 @@ def test_fused_update_matches_standalone(env, algo):
 
     for a, b in zip(jax.tree.leaves(state_dist), jax.tree.leaves(state_fused), strict=True):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The diag pytree is nested (learning-dynamics plane) — compare its
+    # leaves tree-wise; every other metric is a scalar.
+    diag_dist = metrics_dist.pop("diag", None)
+    diag_fused = metrics_fused.pop("diag", None)
+    assert (diag_dist is None) == (diag_fused is None)
+    if diag_dist is not None:
+        da, db = jax.tree.leaves(diag_dist), jax.tree.leaves(diag_fused)
+        for a, b in zip(da, db, strict=True):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg="diag differs"
+            )
     for k in metrics_dist:
         np.testing.assert_array_equal(
             np.asarray(metrics_dist[k]), np.asarray(metrics_fused[k]),

@@ -1,0 +1,399 @@
+"""Learning-dynamics plane (ISSUE 19): derived-metric math against
+closed-form numpy, the staleness-bucketed on-device accumulator, the
+policy-version sidecar through assembler and stores, gauge/jsonl
+publication, and the bit-identity contract — ``Config.learn_diag`` must
+not change a single bit of params or optimizer state in any algorithm,
+including the chained data-parallel dispatch."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import small_config
+from tests.test_algos import make_batch
+from tpu_rl.algos.registry import get_algo
+from tpu_rl.data.assembler import RolloutAssembler
+from tpu_rl.data.layout import BatchLayout
+from tpu_rl.data.shm_ring import OnPolicyStore, ReplayStore, alloc_handles
+from tpu_rl.models.families import ALGOS
+from tpu_rl.obs.learn import (
+    APPROX_KL_HIST,
+    BY_STALE_ESS_GAUGE,
+    ENTROPY_GAUGE,
+    ESS_HIST,
+    GAUGE_PREFIX,
+    N_STALE_BUCKETS,
+    STALE_BUCKET_LABELS,
+    DiagAccumulator,
+    derive,
+    ess_normalized,
+    explained_variance,
+    host_stale_rows,
+    learn_record,
+    module_grad_norms,
+    publish,
+    stale_bucket_index,
+)
+from tpu_rl.obs.registry import MetricsRegistry
+from tpu_rl.types import BATCH_FIELDS
+
+
+# --------------------------------------------------------------- pure math
+def test_ess_uniform_weights_is_one():
+    w = np.ones(64)
+    assert ess_normalized(w.mean(), (w**2).mean()) == pytest.approx(1.0)
+
+
+def test_ess_degenerate_weights_is_one_over_n():
+    # One element carries all the mass: (Σw)²/(N·Σw²) = 1/N.
+    n = 32
+    w = np.zeros(n)
+    w[0] = n  # mean 1, like a normalized IS batch
+    assert ess_normalized(w.mean(), (w**2).mean()) == pytest.approx(1 / n)
+
+
+def test_ess_matches_closed_form_on_random_weights():
+    rng = np.random.default_rng(0)
+    w = np.exp(rng.normal(size=256))
+    expect = w.sum() ** 2 / (w.size * (w**2).sum())
+    assert ess_normalized(w.mean(), (w**2).mean()) == pytest.approx(expect)
+
+
+def test_ess_no_data_is_zero():
+    assert ess_normalized(0.0, 0.0) == 0.0
+
+
+def test_explained_variance_closed_form():
+    rng = np.random.default_rng(1)
+    ret = rng.normal(size=512)
+    err = 0.3 * rng.normal(size=512)  # residual of a decent predictor
+    expect = 1.0 - err.var() / ret.var()
+    got = explained_variance(
+        ret.mean(), (ret**2).mean(), err.mean(), (err**2).mean()
+    )
+    assert got == pytest.approx(expect, rel=1e-6)
+    # Perfect predictor: err == 0 everywhere.
+    assert explained_variance(
+        ret.mean(), (ret**2).mean(), 0.0, 0.0
+    ) == pytest.approx(1.0)
+    # Constant predictor: err = ret - c has Var(err) = Var(ret) -> 0.
+    err_c = ret - 2.0
+    assert explained_variance(
+        ret.mean(), (ret**2).mean(), err_c.mean(), (err_c**2).mean()
+    ) == pytest.approx(0.0, abs=1e-9)
+    # Degenerate targets score 0, not a division blowup.
+    assert explained_variance(3.0, 9.0, 0.5, 1.0) == 0.0
+
+
+def test_stale_bucket_index_power_of_two_layout():
+    stale = jnp.asarray(
+        [0, 1, 2, 3, 4, 7, 8, 15, 16, 31, 32, 63, 64, 1000], jnp.float32
+    )
+    got = np.asarray(stale_bucket_index(stale))
+    assert got.tolist() == [0, 1, 2, 2, 3, 3, 4, 4, 5, 5, 6, 6, 7, 7]
+    assert len(STALE_BUCKET_LABELS) == N_STALE_BUCKETS
+
+
+def test_host_stale_rows_clamps_and_degrades():
+    got = host_stale_rows(10, np.asarray([9, 10, 11, -1]), 4)
+    assert got.tolist() == [1.0, 0.0, 0.0, 0.0]
+    assert host_stale_rows(5, None, 3).tolist() == [0.0, 0.0, 0.0]
+    # Size mismatch degrades to all-fresh, never misattributes.
+    assert host_stale_rows(5, np.asarray([0, 1]), 3).tolist() == [0.0] * 3
+
+
+def test_module_grad_norms_groups_by_path():
+    grads = {
+        "body_mlp": {"w": jnp.full((2, 2), 2.0)},
+        "cell": {"k": jnp.full((3,), 1.0)},
+        "pi_head": jnp.full((4,), 0.5),
+    }
+    got = {k: float(v) for k, v in module_grad_norms(grads).items()}
+    assert got["torso"] == pytest.approx(4.0)  # sqrt(4 * 2²)
+    assert got["cell"] == pytest.approx(math.sqrt(3.0))
+    assert got["heads"] == pytest.approx(1.0)  # sqrt(4 * 0.5²)
+
+
+# ------------------------------------------------------------- accumulator
+def _diag(kl, w, stalev=None):
+    return {
+        "rows": {
+            "kl": jnp.asarray(kl, jnp.float32),
+            "w": jnp.asarray(w, jnp.float32),
+            "w2": jnp.asarray(np.square(w), jnp.float32),
+        },
+        "scalars": {"param-norm": jnp.asarray(10.0)},
+    }
+
+
+def test_accumulator_splits_ess_across_staleness_buckets():
+    """Fresh rows with uniform weights vs lagged rows with a collapsed
+    weight distribution must land in different buckets with different ESS —
+    the curve the IMPACT controller will regulate on."""
+    acc = DiagAccumulator()
+    # Dispatch 1: 4 fresh rows, uniform weights (per-row mean of w and w²
+    # both 1 -> ESS 1).
+    acc.add(_diag([0.01] * 4, [1.0] * 4), jnp.zeros((4,)))
+    # Dispatch 2: 4 rows at staleness 3, heavy-tailed weights (E[w]=1,
+    # E[w²]=4 -> ESS 0.25).
+    acc.add(
+        {
+            "rows": {
+                "kl": jnp.full((4,), 0.2),
+                "w": jnp.ones((4,)),
+                "w2": jnp.full((4,), 4.0),
+            },
+            "scalars": {"param-norm": jnp.asarray(10.0)},
+        },
+        jnp.full((4,), 3.0),
+    )
+    doc = acc.drain(idx=7)
+    assert doc is not None
+    assert doc["n_updates"] == 2.0
+    assert set(doc["buckets"]) == {"0", "2-3"}
+    assert doc["buckets"]["0"]["ess"] == pytest.approx(1.0)
+    assert doc["buckets"]["2-3"]["ess"] == pytest.approx(0.25)
+    assert doc["buckets"]["0"]["rows"] == 4.0
+    # Global pools both: E[w]=1, E[w²]=2.5 -> 0.4.
+    assert doc["global"]["ess"] == pytest.approx(0.4)
+    assert doc["global"]["approx-kl"] == pytest.approx((0.01 + 0.2) / 2)
+    assert doc["global"]["param-norm"] == pytest.approx(10.0)
+    # Drain resets: nothing accumulated -> None.
+    assert acc.drain(idx=8) is None
+
+
+def test_accumulate_honors_chained_update_count():
+    acc = DiagAccumulator()
+    d = _diag([0.1] * 6, [1.0] * 6)
+    d["n-updates"] = jnp.asarray(3.0)  # one chained dispatch of K=3
+    acc.add(d, jnp.zeros((6,)))
+    doc = acc.drain(idx=1)
+    assert doc["n_updates"] == 3.0
+    # Scalars average per UPDATE, not per dispatch.
+    assert doc["global"]["param-norm"] == pytest.approx(10.0 / 3.0)
+
+
+def test_derive_update_ratio():
+    acc = {
+        "n-updates": np.asarray(2.0),
+        "rows-n": np.zeros(N_STALE_BUCKETS),
+        "rows": {},
+        "scalars": {
+            "update-norm": np.asarray(0.2),
+            "param-norm": np.asarray(20.0),
+        },
+    }
+    doc = derive(acc)
+    assert doc["global"]["update-ratio"] == pytest.approx(0.01)
+    assert doc["buckets"] == {}
+
+
+# -------------------------------------------------------- publish / record
+def test_publish_gauges_and_learn_record_shape():
+    reg = MetricsRegistry(role="learner", pid=0, host="h")
+    doc = {
+        "n_updates": 4.0,
+        "global": {"entropy": 0.7, "approx-kl": 0.02, "ess": 0.9},
+        "buckets": {"0": {"ess": 0.95, "rows": 32.0}},
+    }
+    publish(reg, doc)
+    snap = reg.snapshot()
+    gauges = {(n, tuple(sorted(l.items()))): v for n, l, v in snap["gauges"]}
+    # The documented headline names (drift-checked constants) are exactly
+    # what publish() emits — prefix + channel must never drift from them.
+    assert gauges[(ENTROPY_GAUGE, ())] == 0.7
+    assert gauges[(BY_STALE_ESS_GAUGE, (("stale_bucket", "0"),))] == 0.95
+    hist_names = {n for n, *_ in snap["hists"]}
+    assert APPROX_KL_HIST in hist_names
+    assert ESS_HIST in hist_names
+    rec = learn_record(17, doc)
+    assert rec["idx"] == 17
+    assert rec["n_updates"] == 4.0
+    assert rec["ess"] == 0.9
+    assert rec["buckets"]["0"]["rows"] == 32.0
+    assert "ts" in rec
+
+
+# ------------------------------------------------------- version sidecar
+def _layout():
+    return BatchLayout.from_config(small_config())
+
+
+def _window(layout, value=0.0):
+    return {
+        f: np.full((layout.seq_len, layout.width(f)), value, np.float32)
+        for f in BATCH_FIELDS
+    }
+
+
+def test_onpolicy_store_version_sidecar_roundtrip():
+    layout = _layout()
+    store = OnPolicyStore(alloc_handles(layout, 8), layout)
+    assert store.put(_window(layout), ver=5)
+    assert store.put_many([_window(layout)] * 2, vers=[7, 9]) == 2
+    out = store.consume(need=3)
+    assert out["ver"].tolist() == [5, 7, 9]
+    # Unversioned puts read back as -1 (unknown), not as stale garbage.
+    assert store.put(_window(layout))
+    assert store.consume(need=1)["ver"].tolist() == [-1]
+
+
+def test_replay_store_version_sidecar_survives_sampling():
+    layout = _layout()
+    store = ReplayStore(alloc_handles(layout, 8), layout)
+    store.put_many([_window(layout)] * 4, vers=[3, 4, 5, 6])
+    out = store.sample(4, np.random.default_rng(0))
+    vers = out["ver"]
+    assert vers.shape == (4,)
+    assert set(vers.tolist()) <= {3, 4, 5, 6}
+    assert len(set(vers.tolist())) >= 2  # sampling actually mixes slots
+
+
+def test_assembler_threads_min_version_to_pop_many_full():
+    layout = _layout()
+    asm = RolloutAssembler(layout, lag_sec=60.0)
+    n = layout.seq_len
+    for t in range(n):
+        payload = {
+            f: np.zeros((1, layout.width(f)), np.float32)
+            for f in BATCH_FIELDS
+        }
+        payload["id"] = ["ep0"]
+        payload["done"] = np.zeros(1, np.uint8)
+        # Version climbs mid-window: the window's ver must be the OLDEST
+        # contributing tick (conservative staleness attribution).
+        payload["ver"] = 11 + t
+        asm.push_tick(payload)
+    windows, traces, vers = asm.pop_many_full()
+    assert len(windows) == 1 and vers == [11]
+    # Requeue preserves the pairing for the retry path.
+    asm.requeue(windows, traces, vers)
+    _, _, vers2 = asm.pop_many_full()
+    assert vers2 == [11]
+
+
+# ------------------------------------------------------------ bit-identity
+def _state_leaves(state):
+    return jax.tree_util.tree_leaves(jax.device_get(state))
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+def test_diag_bit_identity(algo):
+    """The whole train state after two updates — params, optimizer state,
+    targets, duals, step — must be BITWISE equal with learn_diag on vs off.
+    Diagnostics observe the update; they never perturb it."""
+    kw = dict(
+        algo=algo,
+        action_space=1 if "Continuous" in algo else 2,
+        is_continuous="Continuous" in algo,
+    )
+    cfg_on = small_config(learn_diag=True, **kw)
+    cfg_off = small_config(learn_diag=False, **kw)
+    states = []
+    for cfg in (cfg_on, cfg_off):
+        fam, state, train_step = get_algo(algo).build(cfg, jax.random.PRNGKey(0))
+        step = jax.jit(train_step)
+        batch = make_batch(cfg, fam)
+        for i in (1, 2):
+            state, metrics = step(state, batch, jax.random.PRNGKey(i))
+        assert ("diag" in metrics) == cfg.learn_diag
+        states.append(state)
+    on, off = (_state_leaves(s) for s in states)
+    for a, b in zip(on, off, strict=True):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_diag_bit_identity_chained_dispatch():
+    """Same contract through parallel.dp chain>1 (the scan-stacked metrics
+    path that flattens diag rows and sums scalars)."""
+    from tpu_rl.parallel import (
+        make_mesh,
+        make_parallel_train_step,
+        replicate,
+        shard_chained_batch,
+    )
+
+    K = 2
+    states = []
+    for diag_on in (True, False):
+        cfg = small_config(algo="PPO", batch_size=8, learn_diag=diag_on)
+        fam, state, train_step = get_algo("PPO").build(cfg, jax.random.PRNGKey(0))
+        batches = [make_batch(cfg, fam, key=s) for s in range(K)]
+        mesh = make_mesh(4)
+        cstep = make_parallel_train_step(train_step, mesh, cfg, chain=K)
+        state, metrics = cstep(
+            replicate(state, mesh),
+            shard_chained_batch(batches, mesh),
+            replicate(jax.random.PRNGKey(7), mesh),
+        )
+        if diag_on:
+            diag = metrics["diag"]
+            # Chained diag: rows flattened to (K*B,), update count carried.
+            assert diag["rows"]["ent"].shape == (K * 8,)
+            assert float(diag["n-updates"]) == float(K)
+        else:
+            assert "diag" not in metrics
+        states.append(state)
+    on, off = (_state_leaves(s) for s in states)
+    for a, b in zip(on, off, strict=True):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------------- live /metrics
+@pytest.mark.timeout(30)
+def test_diag_gauges_reach_live_metrics_scrape():
+    """The acceptance path end to end on the export side: a drained diag
+    document published into a live TelemetryAggregator must appear in an
+    actual HTTP /metrics scrape — Prometheus-sanitized global gauges, the
+    staleness-labeled family, and the histogram copies."""
+    import urllib.request
+
+    from tpu_rl.obs import TelemetryAggregator, TelemetryHTTPServer
+
+    agg = TelemetryAggregator()
+    doc = {
+        "n_updates": 8.0,
+        "global": {"entropy": 0.69, "approx-kl": 0.015, "ess": 0.93},
+        "buckets": {
+            "0": {"ess": 0.97, "rows": 48.0},
+            "2-3": {"ess": 0.81, "rows": 16.0},
+        },
+    }
+    publish(agg.registry, doc)
+    srv = TelemetryHTTPServer(agg, port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            assert r.status == 200
+            body = r.read().decode()
+    finally:
+        srv.close()
+    def samples(metric):
+        """{frozen label string: value} for one exact metric name (the
+        registry adds host/pid/role labels to every exposition line)."""
+        out = {}
+        for ln in body.splitlines():
+            if ln.startswith("#") or " " not in ln:
+                continue
+            head, val = ln.rsplit(" ", 1)
+            name = head.split("{", 1)[0]
+            if name == metric:
+                out[head[len(name):]] = float(val)
+        return out
+
+    assert list(samples("learner_diag_entropy").values()) == [0.69]
+    assert list(samples("learner_diag_approx_kl").values()) == [0.015]
+    # ESS split across >=2 staleness buckets, label preserved verbatim
+    by_stale = samples("learner_diag_by_stale_ess")
+    got = {
+        ("0" if 'stale_bucket="0"' in k else "2-3"): v
+        for k, v in by_stale.items()
+    }
+    assert got == {"0": 0.97, "2-3": 0.81}
+    assert 'stale_bucket="2-3"' in "".join(by_stale)
+    assert samples("learner_diag_approx_kl_hist_count")
+    assert samples("learner_diag_ess_hist_count")

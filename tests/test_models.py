@@ -188,6 +188,10 @@ def test_bf16_lstm_mixed_precision():
         log_prob=jnp.full(zb.log_prob.shape, -0.69),
     )
     state, metrics = jax.jit(train_step)(state, batch, jax.random.PRNGKey(3))
+    # diag is a nested pytree (learning-dynamics plane) — check its leaves.
+    diag = metrics.pop("diag", None)
+    for leaf in jax.tree_util.tree_leaves(diag):
+        assert np.isfinite(np.asarray(leaf)).all()
     for k, v in metrics.items():
         assert np.isfinite(np.asarray(v)).all(), (k, v)
 

@@ -80,6 +80,14 @@ MANIFEST: dict[str, dict[str, str]] = {
         "BoundedPipe.put": STRICT,
         "BoundedPipe.get": STRICT,
     },
+    "tpu_rl/obs/learn.py": {
+        # The learning-dynamics fold rides every learner dispatch (one
+        # extra device program, zero syncs — the whole plane's overhead
+        # contract, bench_diag.cpu.json); the host-side wrapper must stay
+        # allocation-free so the cost is the device fold alone. drain() is
+        # cold (log cadence) and deliberately NOT pinned.
+        "DiagAccumulator.add": STRICT,
+    },
 }
 
 # Helpers whose call is an allocation/serialization bomb regardless of tier.

@@ -43,6 +43,9 @@ TARGETS = (
     "tpu_rl/parallel",
     "tpu_rl/algos",
     "tpu_rl/ops",
+    # The learning-dynamics plane's jitted fold (make_accumulate ->
+    # jax.jit(accumulate)) and the in-jit bucket math it closes over.
+    "tpu_rl/obs/learn.py",
 )
 
 _HOST_SYNC_CALLS = {

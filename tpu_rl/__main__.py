@@ -114,6 +114,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry-port", type=int, default=None,
                    help="serve Prometheus /metrics + /healthz from the "
                    "storage process on this port (0/unset = off)")
+    p.add_argument("--no-learn-diag", action="store_true",
+                   help="disable the learning-dynamics plane (in-jit "
+                   "entropy/KL/ESS/clip diagnostics, staleness-conditioned "
+                   "learner-diag-* gauges, result_dir/learn.jsonl); on by "
+                   "default — readback rides the loss-log cadence, so the "
+                   "steady-state cost is one extra fused device program")
+    p.add_argument("--watchdog-diag", action="store_true",
+                   help="feed approx-KL and negated ESS from the "
+                   "learning-dynamics plane into the divergence watchdog's "
+                   "z-score channels (requires the watchdog and learn-diag "
+                   "both on)")
     p.add_argument("--trace-sample-n", type=int, default=None,
                    help="sample every Nth worker tick into the fleet trace "
                    "(result_dir/fleet_trace.json); 0/unset = off")
@@ -209,6 +220,10 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["act_kernel"] = args.act_kernel
     if args.telemetry_port is not None:
         overrides["telemetry_port"] = args.telemetry_port
+    if args.no_learn_diag:
+        overrides["learn_diag"] = False
+    if args.watchdog_diag:
+        overrides["watchdog_diag"] = True
     if args.trace_sample_n is not None:
         overrides["trace_sample_n"] = args.trace_sample_n
     if args.transport is not None:

@@ -19,6 +19,12 @@ from tpu_rl.algos.ppo import policy_outputs
 from tpu_rl.config import Config
 from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
+from tpu_rl.obs.learn import (
+    module_grad_norms,
+    rows_mean,
+    tree_delta_norm,
+    tree_norm,
+)
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
 from tpu_rl.ops.returns import vtrace
 from tpu_rl.types import Batch
@@ -71,12 +77,48 @@ def make_train_step(cfg: Config, family: ModelFamily):
             "max-abs-advantage": jnp.max(jnp.abs(advantages)),
             "mean-advantage": jnp.mean(advantages),
         }
+        if cfg.learn_diag:
+            # Learning-dynamics diag (tpu_rl.obs.learn). The UNCLIPPED
+            # importance ratio drives ESS/KL and the clip-rate channels
+            # (vtrace returns the clipped rho, which hides exactly the
+            # tail the staleness curves are meant to expose).
+            lr = jax.lax.stop_gradient(
+                log_probs[:, :-1] - batch.log_prob[:, :-1]
+            )
+            w = jnp.exp(lr)
+            vt = values_target[:, :-1]
+            err = vt - jax.lax.stop_gradient(value[:, :-1])
+            metrics["diag"] = {
+                "rows": {
+                    "ent": rows_mean(
+                        jax.lax.stop_gradient(entropy[:, :-1])
+                    ),
+                    "kl": rows_mean(-lr),
+                    "rho-clip": rows_mean(
+                        (w >= cfg.rho_bar).astype(jnp.float32)
+                    ),
+                    "c-clip": rows_mean(
+                        (w >= cfg.c_bar).astype(jnp.float32)
+                    ),
+                    "w": rows_mean(w),
+                    "w2": rows_mean(jnp.square(w)),
+                    "adv": rows_mean(advantages),
+                    "adv2": rows_mean(jnp.square(advantages)),
+                    "ret": rows_mean(vt),
+                    "ret2": rows_mean(jnp.square(vt)),
+                    "err": rows_mean(err),
+                    "err2": rows_mean(jnp.square(err)),
+                },
+                "scalars": {},
+            }
         return loss, metrics
 
     guard = cfg.update_guard
 
     def train_step(state: TrainState, batch: Batch, key: jax.Array):
+        params0 = state.params
         metrics = {}
+        grads = None
         nf = 0.0
         for _ in range(cfg.K_epoch):
             (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -103,6 +145,17 @@ def make_train_step(cfg: Config, family: ModelFamily):
             metrics["grad-norm"] = gnorm
         if guard:
             metrics["nonfinite-updates"] = nf
+        if cfg.learn_diag:
+            metrics["diag"]["scalars"].update(
+                {
+                    f"grad-norm-{k}": v
+                    for k, v in module_grad_norms(grads).items()
+                }
+            )
+            metrics["diag"]["scalars"]["update-norm"] = tree_delta_norm(
+                state.params, params0
+            )
+            metrics["diag"]["scalars"]["param-norm"] = tree_norm(state.params)
         return state.replace(step=state.step + 1), metrics
 
     return train_step

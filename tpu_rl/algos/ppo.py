@@ -17,6 +17,12 @@ from tpu_rl.algos.base import TrainState, rmsprop
 from tpu_rl.config import Config
 from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
+from tpu_rl.obs.learn import (
+    module_grad_norms,
+    rows_mean,
+    tree_delta_norm,
+    tree_norm,
+)
 from tpu_rl.ops import distributions as D
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
 from tpu_rl.ops.returns import gae
@@ -82,12 +88,43 @@ def make_train_step(cfg: Config, family: ModelFamily):
             "max-ratio": jnp.max(ratio),
             "avg-ratio": jnp.mean(ratio),
         }
+        if cfg.learn_diag:
+            # Learning-dynamics diag (tpu_rl.obs.learn): per-row moment
+            # means of quantities the loss already computed — all no-grad,
+            # never fed back (bit-identity pinned in tests).
+            lr = jax.lax.stop_gradient(
+                log_probs[:, :-1] - batch.log_prob[:, :-1]
+            )
+            w = jnp.exp(lr)
+            ent = jax.lax.stop_gradient(entropy[:, :-1])
+            err = td_target - jax.lax.stop_gradient(value[:, :-1])
+            metrics["diag"] = {
+                "rows": {
+                    "ent": rows_mean(ent),
+                    # k1 approx-KL estimator: E[logp_behav - logp_new]
+                    "kl": rows_mean(-lr),
+                    "clip": rows_mean(
+                        (jnp.abs(w - 1.0) > cfg.eps_clip).astype(jnp.float32)
+                    ),
+                    "w": rows_mean(w),
+                    "w2": rows_mean(jnp.square(w)),
+                    "adv": rows_mean(advantage),
+                    "adv2": rows_mean(jnp.square(advantage)),
+                    "ret": rows_mean(td_target),
+                    "ret2": rows_mean(jnp.square(td_target)),
+                    "err": rows_mean(err),
+                    "err2": rows_mean(jnp.square(err)),
+                },
+                "scalars": {},
+            }
         return loss, metrics
 
     guard = cfg.update_guard
 
     def train_step(state: TrainState, batch: Batch, key: jax.Array):
+        params0 = state.params
         metrics = {}
+        grads = None
         nf = 0.0
         for _ in range(cfg.K_epoch):
             (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -114,6 +151,17 @@ def make_train_step(cfg: Config, family: ModelFamily):
             metrics["grad-norm"] = gnorm
         if guard:
             metrics["nonfinite-updates"] = nf
+        if cfg.learn_diag:
+            metrics["diag"]["scalars"].update(
+                {
+                    f"grad-norm-{k}": v
+                    for k, v in module_grad_norms(grads).items()
+                }
+            )
+            metrics["diag"]["scalars"]["update-norm"] = tree_delta_norm(
+                state.params, params0
+            )
+            metrics["diag"]["scalars"]["param-norm"] = tree_norm(state.params)
         return state.replace(step=state.step + 1), metrics
 
     return train_step

@@ -22,7 +22,13 @@ from tpu_rl.algos.base import SACState, adam
 from tpu_rl.config import Config
 from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
-from tpu_rl.ops.distributions import tanh_normal_sample
+from tpu_rl.obs.learn import (
+    module_grad_norms,
+    rows_mean,
+    tree_delta_norm,
+    tree_norm,
+)
+from tpu_rl.ops.distributions import normal_log_prob, tanh_normal_sample
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
 from tpu_rl.ops.target import polyak_update
 from tpu_rl.types import Batch
@@ -236,6 +242,59 @@ def make_train_step(cfg: Config, family: ModelFamily):
             "loss_alpha": loss_alpha,
             "alpha": jnp.exp(log_alpha),
         }
+        if cfg.learn_diag:
+            # Learning-dynamics diag (tpu_rl.obs.learn), off-policy flavor:
+            # KL / importance weights compare the CURRENT actor's log-prob
+            # of the replayed action against the behavior log-prob stored
+            # with it — the staleness channel for a replay-fed learner —
+            # plus the soft TD target moments (the "target-Q stats" row of
+            # the diag table). Everything reuses the critic-section
+            # forward; nothing feeds back (bit-identity pinned in tests).
+            if continuous:
+                pre = jnp.arctanh(
+                    jnp.clip(batch.act, -1.0 + 1e-6, 1.0 - 1e-6)
+                )
+                logp_act = normal_log_prob(
+                    mu, jnp.exp(log_std), pre
+                ) - jnp.log(1.0 - jnp.square(batch.act) + 1e-7)
+                lr = jnp.sum(logp_act - batch.log_prob, axis=-1)[:, :-1]
+            else:
+                logp_new = jnp.take_along_axis(
+                    logp_cri, batch.act.astype(jnp.int32), axis=-1
+                )
+                lr = (logp_new - batch.log_prob)[:, :-1, 0]
+            # Entropy rows come from the ACTOR section's ``ent_neg`` aux —
+            # it is already materialized for the alpha loss, so the diag
+            # adds no new consumer to the critic-section forward (a fresh
+            # ``probs * logp`` product there refuses XLA's critic-update
+            # kernels and breaks the bitwise contract by ~1 ulp; measured).
+            ent_rows = -ent_neg
+            lr = sg(lr)
+            w = jnp.exp(lr)
+            # optimization_barrier: the diag's extra reductions over
+            # td_target / the critic grads must not refuse into the update's
+            # own kernels (measured: without the barrier XLA reassociates
+            # the critic update by ~1 ulp, breaking the bitwise contract).
+            ob = jax.lax.optimization_barrier
+            tq_rows = ob(td_target)
+            g_diag = ob({"actor": g_actor, "critic": g_critic})
+            metrics["diag"] = {
+                "rows": {
+                    "ent": rows_mean(sg(ent_rows)),
+                    "kl": rows_mean(-lr),
+                    "w": rows_mean(w),
+                    "w2": rows_mean(jnp.square(w)),
+                    "tq": rows_mean(tq_rows),
+                    "tq2": rows_mean(jnp.square(tq_rows)),
+                },
+                "scalars": {
+                    "alpha": jnp.exp(log_alpha),
+                    **{
+                        f"grad-norm-{k}": v
+                        for k, v in module_grad_norms(g_diag).items()
+                    },
+                },
+            }
         if guard:
             metrics["grad-norm"] = gn_actor + gn_critic
             metrics["nonfinite-updates"] = 1.0 - (
@@ -255,6 +314,7 @@ def make_train_step(cfg: Config, family: ModelFamily):
         )
 
     def train_step(state: SACState, batch: Batch, key: jax.Array):
+        params0 = (state.actor_params, state.critic_params, state.log_alpha)
         metrics = {}
         nf = 0.0
         for e in range(cfg.K_epoch):
@@ -263,6 +323,14 @@ def make_train_step(cfg: Config, family: ModelFamily):
                 nf = nf + metrics.pop("nonfinite-updates")
         if guard:
             metrics["nonfinite-updates"] = nf
+        if cfg.learn_diag:
+            params1 = (
+                state.actor_params, state.critic_params, state.log_alpha,
+            )
+            metrics["diag"]["scalars"]["update-norm"] = tree_delta_norm(
+                params1, params0
+            )
+            metrics["diag"]["scalars"]["param-norm"] = tree_norm(params1)
         return state.replace(step=state.step + 1), metrics
 
     return train_step

@@ -33,6 +33,12 @@ from tpu_rl.algos.ppo import policy_outputs, td_target_and_gae
 from tpu_rl.config import Config
 from tpu_rl.heal.guards import guarded, update_ok
 from tpu_rl.models.families import ModelFamily
+from tpu_rl.obs.learn import (
+    module_grad_norms,
+    rows_mean,
+    tree_delta_norm,
+    tree_norm,
+)
 from tpu_rl.ops.distributions import categorical_kl
 from tpu_rl.ops.losses import clip_subtree_by_global_norm, smooth_l1
 from tpu_rl.types import Batch
@@ -126,12 +132,47 @@ def make_train_step(cfg: Config, family: ModelFamily):
             "vmpo-alpha": alpha,
             "kl": jnp.mean(kl),
         }
+        if cfg.learn_diag:
+            # Learning-dynamics diag (tpu_rl.obs.learn): action-level k1
+            # approx-KL / importance weights vs the behavior policy (the
+            # full-distribution KL above is the trust-region dual's input;
+            # this one is the cross-algo-comparable staleness channel).
+            lr = jax.lax.stop_gradient(
+                log_probs[:, :-1] - batch.log_prob[:, :-1]
+            )
+            w = jnp.exp(lr)
+            err = td_target - jax.lax.stop_gradient(value[:, :-1])
+            metrics["diag"] = {
+                "rows": {
+                    "ent": rows_mean(
+                        jax.lax.stop_gradient(_entropy[:, :-1])
+                    ),
+                    "kl": rows_mean(-lr),
+                    "w": rows_mean(w),
+                    "w2": rows_mean(jnp.square(w)),
+                    "adv": rows_mean(advantage),
+                    "adv2": rows_mean(jnp.square(advantage)),
+                    "ret": rows_mean(td_target),
+                    "ret2": rows_mean(jnp.square(td_target)),
+                    "err": rows_mean(err),
+                    "err2": rows_mean(jnp.square(err)),
+                },
+                "scalars": {
+                    # Temperature / trust-region Lagrange state: the knobs
+                    # V-MPO self-tunes, surfaced next to the curves they
+                    # shape.
+                    "eta": jax.lax.stop_gradient(eta),
+                    "vmpo-alpha": jax.lax.stop_gradient(alpha),
+                },
+            }
         return loss, metrics
 
     guard = cfg.update_guard
 
     def train_step(state: TrainState, batch: Batch, key: jax.Array):
+        params0 = state.params
         metrics = {}
+        grads = None
         nf = 0.0
         for e in range(cfg.K_epoch):
             ekey = jax.random.fold_in(key, e)
@@ -174,6 +215,17 @@ def make_train_step(cfg: Config, family: ModelFamily):
             metrics["grad-norm"] = gnorm
         if guard:
             metrics["nonfinite-updates"] = nf
+        if cfg.learn_diag:
+            metrics["diag"]["scalars"].update(
+                {
+                    f"grad-norm-{k}": v
+                    for k, v in module_grad_norms(grads).items()
+                }
+            )
+            metrics["diag"]["scalars"]["update-norm"] = tree_delta_norm(
+                state.params, params0
+            )
+            metrics["diag"]["scalars"]["param-norm"] = tree_norm(state.params)
         return state.replace(step=state.step + 1), metrics
 
     return train_step

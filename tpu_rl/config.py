@@ -479,6 +479,13 @@ class Config:
     # Cumulative guard-skipped updates (since the last rollback) that
     # trigger a rollback immediately — the contained-NaN-stream channel.
     watchdog_nonfinite: int = 3
+    # Feed the learning-dynamics diagnostics (tpu_rl.obs.learn) into the
+    # watchdog as extra z-score channels: sustained approx-KL spikes and
+    # importance-weight ESS collapse become rollback trip signals alongside
+    # loss/grad-norm. Requires learn_diag (the signals don't exist without
+    # it) and watchdog_enabled. Default off: diagnostics observe, the
+    # watchdog acts — coupling them is an explicit operator choice.
+    watchdog_diag: bool = False
     # Sliding-window rollback budget (the supervisor restart-budget shape):
     # at most `max_rollbacks` rollbacks per trailing `rollback_window_s`
     # seconds; an exhausted budget exits the learner cleanly — a run that
@@ -502,6 +509,17 @@ class Config:
     # its strikes (un-quarantine on clean re-probe).
     quarantine_clear_s: float = 2.0
     # ---- telemetry plane (tpu_rl.obs) ----
+    # Learning-dynamics diagnostics (tpu_rl.obs.learn): every train_step
+    # additionally returns an in-jit `diag` pytree (entropy, approx-KL,
+    # clip/rho/c rates, importance-weight ESS, advantage moments, value
+    # explained-variance, per-module grad norms, update/param norm) which
+    # the learner accumulates ON DEVICE — bucketed by the batch's policy
+    # staleness — and publishes as `learner-diag-*` gauges plus a
+    # result_dir/learn.jsonl timeline at the loss-log cadence. Guard-style
+    # bit-identity contract: diag on/off never changes a bit of params or
+    # opt state (pinned per algo in tests). Off = the algos return exactly
+    # the pre-diag metrics dict and no accumulator exists.
+    learn_diag: bool = True
     # HTTP port for the storage-side exporter serving Prometheus text at
     # /metrics and staleness-aware liveness at /healthz. 0 = no server, no
     # socket. The plane as a whole (registries, Telemetry frames, the
@@ -789,6 +807,15 @@ class Config:
                 f"watchdog_enabled requires ckpt_keep >= 2 (got "
                 f"{self.ckpt_keep}): rollback restores the previous "
                 "committed checkpoint"
+            )
+        if self.watchdog_diag:
+            assert self.watchdog_enabled, (
+                "watchdog_diag extends the watchdog's signal set; enable "
+                "watchdog_enabled (and its prerequisites) first"
+            )
+            assert self.learn_diag, (
+                "watchdog_diag requires learn_diag: the approx-KL/ESS "
+                "signals come from the learning-dynamics diagnostics"
             )
         if self.chaos_spec:
             # Parse-check here so a bad plan fails at config load, not

@@ -56,7 +56,7 @@ class Trajectory:
     a last-activity timestamp instead of a creation timestamp. When the last
     row fills, ``cols`` is emitted as the window itself — no stacking pass."""
 
-    __slots__ = ("cols", "n", "last_push", "traces")
+    __slots__ = ("cols", "n", "last_push", "traces", "ver")
 
     def __init__(self, cols: dict[str, np.ndarray], last_push: float = 0.0):
         self.cols = cols
@@ -66,6 +66,11 @@ class Trajectory:
         # (tpu_rl.obs): None until the first sampled tick touches this
         # trajectory, so untraced runs never allocate the list.
         self.traces = None
+        # Policy version of the OLDEST contributing tick (-1 = unknown):
+        # the staleness sidecar the learning-dynamics plane bins on
+        # (tpu_rl.obs.learn). Min, not last — a spliced window's staleness
+        # is its worst row's, the conservative bound.
+        self.ver = -1
 
     def __len__(self) -> int:
         return self.n
@@ -95,11 +100,13 @@ def split_rollout_batch(payload: dict) -> list[dict]:
     ``tests/test_push_tick_equivalence.py``)."""
     ids = payload["id"]
     done = np.asarray(payload["done"])
+    ver = payload.get("ver")
     return [
         {
             **{f: payload[f][i] for f in BATCH_FIELDS},
             "id": ids[i],
             "done": bool(done[i]),
+            **({"ver": ver} if isinstance(ver, int) else {}),
         }
         for i in range(len(ids))
     ]
@@ -126,6 +133,10 @@ class RolloutAssembler:
         # None until the FIRST traced tick arrives (then backfilled with
         # Nones), so the tracing-off path is byte-identical to before.
         self.ready_traces: deque | None = None
+        # Per-window policy-version sidecar (int, -1 = unknown), always
+        # aligned with `ready` — one int per window, so it stays on
+        # unconditionally (no lazy activation like the trace deque).
+        self.ready_vers: deque = deque()
         # observability counters
         self.n_steps = 0
         self.n_windows = 0
@@ -160,6 +171,9 @@ class RolloutAssembler:
             # The seam is a fake time adjacency: force the episode-first flag
             # so GAE/V-trace/value bootstraps are masked across it.
             tj.cols["is_fir"][r] = 1.0
+        ver = step.get("ver")
+        if isinstance(ver, int) and ver >= 0:
+            tj.ver = ver if tj.ver < 0 else min(tj.ver, ver)
         tj.n += 1
         tj.last_push = now
         self.n_steps += 1
@@ -185,6 +199,9 @@ class RolloutAssembler:
         ids = payload["id"]
         done = np.asarray(payload["done"])
         now = self.clock()
+        ver = payload.get("ver")
+        if not (isinstance(ver, int) and ver >= 0):
+            ver = None
         if self.validate:
             self.layout.validate_tick(payload, len(ids))
         if trace_id is not None:
@@ -202,6 +219,8 @@ class RolloutAssembler:
                 if tj.traces is None:
                     tj.traces = []
                 tj.traces.append(trace_id)
+            if ver is not None:
+                tj.ver = ver if tj.ver < 0 else min(tj.ver, ver)
             tj.n += 1
             tj.last_push = now
             emitted += self._close_row(eid, tj, bool(done[i]))
@@ -242,6 +261,7 @@ class RolloutAssembler:
             self.ready.append(out.cols)
             if self.ready_traces is not None:
                 self.ready_traces.append(out.traces)
+            self.ready_vers.append(out.ver)
             self.n_windows += 1
             return 1
         if done:
@@ -276,13 +296,15 @@ class RolloutAssembler:
         if self.ready_traces is not None:
             self.ready_traces.popleft()  # keep lineage aligned; caller
             # wants only the window — lineage consumers use pop_many_traced
+        if self.ready_vers:  # may run short on direct ready appends
+            self.ready_vers.popleft()
         return self.ready.popleft()
 
     def pop_many(self, max_windows: int | None = None) -> list[dict]:
         """Drain up to ``max_windows`` ready windows (all, when None) — the
         multi-window companion of :meth:`pop` feeding the stores'
         ``put_many`` burst writes."""
-        windows, _ = self.pop_many_traced(max_windows)
+        windows, _, _ = self.pop_many_full(max_windows)
         return windows
 
     def pop_many_traced(
@@ -291,22 +313,45 @@ class RolloutAssembler:
         """:meth:`pop_many` plus each window's lineage (list of trace ids or
         None per window); the traces list itself is None until lineage
         tracking has activated — the untraced path allocates nothing extra."""
+        windows, traces, _ = self.pop_many_full(max_windows)
+        return windows, traces
+
+    def pop_many_full(
+        self, max_windows: int | None = None
+    ) -> tuple[list[dict], list | None, list[int]]:
+        """:meth:`pop_many_traced` plus each window's policy-version sidecar
+        (int, -1 = unknown) — the storage flush path feeds these straight
+        into the stores' per-slot staleness arrays."""
         n = len(self.ready) if max_windows is None else min(
             max_windows, len(self.ready)
         )
         windows = [self.ready.popleft() for _ in range(n)]
+        # The sidecar can run short when a producer appended to ``ready``
+        # directly instead of through push_tick/requeue (tests, external
+        # feeds): degrade those windows to version-unknown, never crash.
+        vers = [
+            self.ready_vers.popleft() if self.ready_vers else -1
+            for _ in range(n)
+        ]
         if self.ready_traces is None:
-            return windows, None
-        return windows, [self.ready_traces.popleft() for _ in range(n)]
+            return windows, None, vers
+        return windows, [self.ready_traces.popleft() for _ in range(n)], vers
 
-    def requeue(self, windows: list[dict], traces: list | None = None) -> None:
+    def requeue(
+        self,
+        windows: list[dict],
+        traces: list | None = None,
+        vers: list[int] | None = None,
+    ) -> None:
         """Put rejected windows back at the FRONT in their original order
         (store-full back-pressure) — replaces direct ``ready`` manipulation
-        so the lineage deque stays aligned."""
+        so the lineage and version deques stay aligned."""
         self.ready.extendleft(reversed(windows))
         if self.ready_traces is not None:
             ts = traces if traces is not None else [None] * len(windows)
             self.ready_traces.extendleft(reversed(ts))
+        vs = vers if vers is not None else [-1] * len(windows)
+        self.ready_vers.extendleft(reversed(vs))
 
     def __len__(self) -> int:
         return len(self.ready)

@@ -46,6 +46,11 @@ class ShmHandles:
     gen: mp.Value  # OnPolicy consume generation
     lock: mp.Lock
     capacity: int
+    # Per-slot policy version of the window's OLDEST contributing tick
+    # (-1 = unknown), the staleness sidecar of the learning-dynamics plane
+    # (tpu_rl.obs.learn). Optional (default None) so handle pickles from
+    # before this field keep constructing.
+    vers: mp.Array | None = None
 
 
 def alloc_handles(
@@ -59,6 +64,8 @@ def alloc_handles(
         f: ctx.Array("f", capacity * layout.seq_len * layout.width(f), lock=False)
         for f in BATCH_FIELDS
     }
+    vers = ctx.Array("q", capacity, lock=False)
+    np.frombuffer(vers, dtype=np.int64)[:] = -1  # -1 = version unknown
     return ShmHandles(
         arrays=arrays,
         versions=ctx.Array("L", capacity, lock=False),
@@ -66,6 +73,7 @@ def alloc_handles(
         gen=ctx.Value("q", 0, lock=False),
         lock=ctx.Lock(),
         capacity=capacity,
+        vers=vers,
     )
 
 
@@ -85,6 +93,11 @@ class _StoreBase:
             for f in BATCH_FIELDS
         }
         self.versions = np.frombuffer(handles.versions, dtype=np.uint64)
+        self.slot_vers = (
+            np.frombuffer(handles.vers, dtype=np.int64)
+            if getattr(handles, "vers", None) is not None
+            else None
+        )
 
     def _write_slot(self, slot: int, window: dict) -> None:
         for f in BATCH_FIELDS:
@@ -92,6 +105,15 @@ class _StoreBase:
 
     def _read_slots(self, idx: np.ndarray | slice) -> dict[str, np.ndarray]:
         return {f: self.views[f][idx].copy() for f in BATCH_FIELDS}
+
+    def _write_vers(self, slots, vers: list | None, off: int, k: int) -> None:
+        """Stamp the staleness sidecar for ``k`` slots (``vers[off:off+k]``,
+        or -1 when the caller carries none)."""
+        if self.slot_vers is None:
+            return
+        self.slot_vers[slots] = (
+            vers[off : off + k] if vers is not None else -1
+        )
 
 
 class OnPolicyStore(_StoreBase):
@@ -104,12 +126,13 @@ class OnPolicyStore(_StoreBase):
     # suffices. The cap makes the no-livelock contract explicit.
     MAX_PUT_RETRIES = 8
 
-    def put(self, window: dict) -> bool:
+    def put(self, window: dict, ver: int = -1) -> bool:
         """Write one (seq, width)-per-field trajectory window. Returns False
         when the current generation is full (caller drops or retries later,
         matching the reference's ``num < mem_size`` guard,
         ``learner_storage.py:139``) or — bounded-retry contract — when
-        consumes keep invalidating the write ``MAX_PUT_RETRIES`` times."""
+        consumes keep invalidating the write ``MAX_PUT_RETRIES`` times.
+        ``ver`` is the window's policy-version sidecar (-1 = unknown)."""
         h = self.h
         for _ in range(self.MAX_PUT_RETRIES):
             with h.lock:
@@ -117,6 +140,7 @@ class OnPolicyStore(_StoreBase):
                 if slot >= self.capacity:
                     return False
             self._write_slot(slot, window)
+            self._write_vers(slice(slot, slot + 1), [ver], 0, 1)
             with h.lock:
                 if h.gen.value == gen:
                     # No consume intervened: publish the slot.
@@ -126,12 +150,14 @@ class OnPolicyStore(_StoreBase):
             # generation (this is the race the reference ignores).
         return False
 
-    def put_many(self, windows: list[dict]) -> int:
+    def put_many(self, windows: list[dict], vers: list | None = None) -> int:
         """Write a burst of trajectory windows with one contiguous slice
         write per field per generation (vs one slot write per window via
         :meth:`put`). Returns how many were accepted — the tail past a full
         generation is rejected, preserving window order, so callers requeue
-        ``windows[accepted:]`` exactly as they would a single rejected put."""
+        ``windows[accepted:]`` exactly as they would a single rejected put.
+        ``vers`` (aligned with ``windows``) stamps each slot's
+        policy-version sidecar."""
         if not windows:
             return 0
         h = self.h
@@ -148,6 +174,7 @@ class OnPolicyStore(_StoreBase):
                     # One slice write per field: numpy stacks the k windows'
                     # (seq, width) arrays straight into the shm view.
                     self.views[f][slot : slot + k] = [w[f] for w in chunk]
+                self._write_vers(slice(slot, slot + k), vers, written, k)
                 with h.lock:
                     if h.gen.value == gen:
                         h.count.value = slot + k
@@ -177,6 +204,10 @@ class OnPolicyStore(_StoreBase):
             if n < need:
                 return None
             out = self._read_slots(slice(0, n))
+            if self.slot_vers is not None:
+                # Staleness sidecar: per-row policy version, a NON-batch key
+                # (Batch.from_mapping keys off BATCH_FIELDS and drops it).
+                out["ver"] = self.slot_vers[:n].copy()
             h.gen.value += 1
             h.count.value = 0
         return out
@@ -187,19 +218,20 @@ class ReplayStore(_StoreBase):
     number of sampling readers."""
 
     # ---------------------------------------------------------------- writer
-    def put(self, window: dict) -> bool:
+    def put(self, window: dict, ver: int = -1) -> bool:
         h = self.h
         with h.lock:
             total = h.count.value
         slot = total % self.capacity
         self.versions[slot] += 1  # odd: write in progress
         self._write_slot(slot, window)
+        self._write_vers(slice(slot, slot + 1), [ver], 0, 1)
         self.versions[slot] += 1  # even: stable
         with h.lock:
             h.count.value = total + 1
         return True
 
-    def put_many(self, windows: list[dict]) -> int:
+    def put_many(self, windows: list[dict], vers: list | None = None) -> int:
         """Ring-write a burst of windows with one fancy-indexed write per
         field per chunk. Chunked to ``capacity`` so the slot set within a
         write stays duplicate-free; across chunks the ring overwrite order
@@ -216,6 +248,7 @@ class ReplayStore(_StoreBase):
             self.versions[slots] += 1  # odd: writes in progress
             for f in BATCH_FIELDS:
                 self.views[f][slots] = [w[f] for w in chunk]
+            self._write_vers(slots, vers, done, k)
             self.versions[slots] += 1  # even: stable
             with h.lock:
                 h.count.value = total + k
@@ -265,16 +298,27 @@ class ReplayStore(_StoreBase):
             )
             for f in BATCH_FIELDS
         }
+        if self.slot_vers is not None:
+            out["ver"] = np.full(batch, -1, np.int64)
         pending = np.arange(batch)
         for _ in range(max_retries):
             sel = idx[pending]
             v1 = self.versions[sel].copy()
             chunk = {f: self.views[f][sel] for f in BATCH_FIELDS}  # copies
+            # The sidecar rides inside the same seqlock bracket as the
+            # field reads, so a sampled row's version is never torn either.
+            sv = (
+                self.slot_vers[sel].copy()
+                if self.slot_vers is not None
+                else None
+            )
             v2 = self.versions[sel].copy()
             ok = (v1 % 2 == 0) & (v2 == v1)
             done = pending[ok]
             for f in BATCH_FIELDS:
                 out[f][done] = chunk[f][ok]
+            if sv is not None:
+                out["ver"][done] = sv[ok]
             pending = pending[~ok]
             if pending.size == 0:
                 return out

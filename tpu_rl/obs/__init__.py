@@ -1,7 +1,8 @@
 """Fleet-wide observability: metrics registries, the telemetry aggregator,
 exporters (Prometheus / JSON / tensorboard), span tracing, the live
 performance plane (MFU/FLOPs/recompiles/device memory + profiler capture),
-and the SLO engine.
+the SLO engine, and the learning-dynamics plane (in-jit algorithm
+diagnostics with staleness-conditioned attribution — ``tpu_rl.obs.learn``).
 
 See ``docs/ARCHITECTURE.md`` ("Observability") for the data flow.
 """
@@ -31,6 +32,20 @@ from tpu_rl.obs.goodput import (
     robust_z,
     straggler_report,
 )
+from tpu_rl.obs.learn import (
+    BUCKET_GAUGE_PREFIX,
+    GAUGE_PREFIX,
+    N_STALE_BUCKETS,
+    STALE_BUCKET_LABELS,
+    DiagAccumulator,
+    derive,
+    ess_normalized,
+    explained_variance,
+    host_stale_rows,
+    learn_record,
+    publish,
+    stale_bucket_index,
+)
 from tpu_rl.obs.merge import merge_result_dir, merge_traces
 from tpu_rl.obs.perf import (
     PEAK_FLOPS,
@@ -54,20 +69,25 @@ from tpu_rl.obs.trace import TraceRecorder
 
 __all__ = [
     "BUCKETS",
+    "BUCKET_GAUGE_PREFIX",
     "ClockEstimate",
     "ClockSync",
     "DEFAULT_STALE_AFTER_S",
+    "DiagAccumulator",
     "FlightRecorder",
+    "GAUGE_PREFIX",
     "GoodputLedger",
     "HIST_BUCKETS",
     "JsonExporter",
     "LEARNER_VERSION_GAUGE",
     "MetricsRegistry",
+    "N_STALE_BUCKETS",
     "PEAK_FLOPS",
     "PerfTracker",
     "PeriodicSnapshot",
     "ProfilerCapture",
     "STALENESS_HIST",
+    "STALE_BUCKET_LABELS",
     "STRAGGLER_GAUGE",
     "SloEngine",
     "SloRule",
@@ -77,10 +97,15 @@ __all__ = [
     "TraceRecorder",
     "append_jsonl",
     "append_resume",
+    "derive",
     "device_memory_bytes",
     "device_peak_flops",
     "diff_snapshots",
+    "ess_normalized",
+    "explained_variance",
     "hist_quantile",
+    "host_stale_rows",
+    "learn_record",
     "maybe_aggregator",
     "maybe_ledger",
     "maybe_perf_tracker",
@@ -90,8 +115,10 @@ __all__ = [
     "merge_traces",
     "parse_slo_spec",
     "process_self_stats",
+    "publish",
     "render_healthz",
     "render_prometheus",
     "robust_z",
+    "stale_bucket_index",
     "straggler_report",
 ]

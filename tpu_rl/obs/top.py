@@ -4,9 +4,11 @@ Polls the storage (or colocated) telemetry HTTP server — ``/metrics``
 (Prometheus text), ``/goodput`` (ledger breakdown + straggler top-k) and
 ``/slo`` (verdicts), plus ``/autopilot`` when a pilot is wired — and
 renders a terminal view on stdlib curses: per-role goodput bars, bucket
-breakdowns, throughput/MFU, the straggler list, autopilot replica/worker
-counts with recent actions and per-rule cooldown status, and SLO
-verdicts. Nothing beyond the standard library; point it at
+breakdowns, throughput/MFU, the LEARN panel (entropy/KL/ESS update-math
+diagnostics with the ESS-vs-staleness curve from the
+``learner-diag-by-stale-*`` families), the straggler list, autopilot
+replica/worker counts with recent actions and per-rule cooldown status,
+and SLO verdicts. Nothing beyond the standard library; point it at
 any fleet with the plane on::
 
     python -m tpu_rl.obs.top --url http://learner-host:9090/metrics
@@ -109,6 +111,39 @@ def _scalar(samples: list, name: str):
     return max(vals) if vals else None
 
 
+_DIAG_GLOBAL_PREFIX = "learner_diag_"
+_DIAG_BUCKET_PREFIX = "learner_diag_by_stale_"
+
+
+def learn_rows(samples: list) -> tuple[dict, dict]:
+    """Learning-dynamics view from the ``learner_diag_*`` gauge families →
+    (global {metric: value}, per-staleness {bucket label: {metric: value}}).
+    Histogram families (``*_hist_*``) are skipped — the panel shows the
+    current gauge values, not the distribution."""
+    glob: dict = {}
+    buckets: dict = {}
+    for name, labels, value in samples:
+        if "_hist" in name:
+            continue
+        if name.startswith(_DIAG_BUCKET_PREFIX):
+            label = labels.get("stale_bucket")
+            if label is None:
+                continue
+            metric = name[len(_DIAG_BUCKET_PREFIX):]
+            buckets.setdefault(label, {})[metric] = value
+        elif name.startswith(_DIAG_GLOBAL_PREFIX):
+            glob[name[len(_DIAG_GLOBAL_PREFIX):]] = value
+    return glob, buckets
+
+
+def _stale_sort_key(label: str) -> float:
+    head = label.split("-")[0].rstrip("+")
+    try:
+        return float(head)
+    except ValueError:
+        return float("inf")
+
+
 def bar(frac: float, width: int = 20) -> str:
     frac = min(1.0, max(0.0, frac))
     filled = round(frac * width)
@@ -155,6 +190,44 @@ def build_frame(
             hot.append(f"{label} {fmt.format(v)}")
     if hot:
         lines.append("THROUGHPUT  " + "   ".join(hot))
+        lines.append("")
+
+    diag, diag_buckets = learn_rows(samples)
+    if diag or diag_buckets:
+        lines.append("LEARN (update-math diagnostics; learner-diag-* gauges)")
+        head = []
+        for label, metric, fmt in (
+            ("entropy", "entropy", "{:.3f}"),
+            ("kl", "approx_kl", "{:.4f}"),
+            ("ess", "ess", "{:.2f}"),
+            ("clip", "clip_frac", "{:.2f}"),
+            ("ev", "explained_variance", "{:.2f}"),
+            ("upd-ratio", "update_ratio", "{:.2e}"),
+        ):
+            v = diag.get(metric)
+            if v is not None:
+                head.append(f"{label} {fmt.format(v)}")
+        if head:
+            lines.append("  " + "   ".join(head))
+        grads = [
+            f"{g} {diag[f'grad_norm_{g}']:.2e}"
+            for g in ("torso", "cell", "heads")
+            if f"grad_norm_{g}" in diag
+        ]
+        if grads:
+            lines.append("  grad-norm  " + "   ".join(grads))
+        # ESS vs staleness: THE off-policy health curve (collapse at high
+        # lag is the signal the update:data controller will regulate on).
+        for label in sorted(diag_buckets, key=_stale_sort_key):
+            b = diag_buckets[label]
+            ess = b.get("ess")
+            if ess is None:
+                continue
+            rows = b.get("rows")
+            tail = f"  ({rows:.0f} rows)" if rows is not None else ""
+            lines.append(
+                f"  stale {label:>5}  [{bar(ess)}] ess {ess:.2f}{tail}"
+            )
         lines.append("")
 
     lines.append("STRAGGLERS (robust z vs fleet median; report-only)")

@@ -70,12 +70,28 @@ def make_parallel_train_step(
             state, ms = jax.lax.scan(
                 body, state, (batch, jnp.arange(chain))
             )
+            diag = ms.pop("diag", None)
             out = jax.tree.map(lambda x: x[-1], ms)
             if "nonfinite-updates" in ms:
                 # Guard-skip counts are per-update; summing over the chain
                 # axis keeps the dispatched program's count exact (the other
                 # metrics stay last-update snapshots).
                 out["nonfinite-updates"] = jnp.sum(ms["nonfinite-updates"])
+            if diag is not None:
+                # Learning-dynamics diag is ACCUMULATED, not snapshotted:
+                # row channels from every chained update flatten to
+                # (chain*B,) — aligned with the learner's flattened per-row
+                # staleness — and scalars sum, with the update count riding
+                # along so the accumulator can renormalize (obs/learn.py).
+                out["diag"] = {
+                    "rows": {
+                        k: v.reshape(-1) for k, v in diag["rows"].items()
+                    },
+                    "scalars": {
+                        k: jnp.sum(v) for k, v in diag["scalars"].items()
+                    },
+                    "n-updates": jnp.float32(chain),
+                }
             return state, out
         finally:
             cells.set_data_mesh(prev)

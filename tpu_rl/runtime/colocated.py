@@ -517,6 +517,20 @@ class ColocatedLoop:
         if ledger is not None:
             from tpu_rl.obs.goodput import CKPT, COMPUTE
         metrics: Any = {}
+        # Learning-dynamics plane: fold each iteration's in-jit ``diag`` into
+        # the on-device accumulator (one tiny extra dispatch, zero syncs) and
+        # drain on the log cadence below. Colocated rollouts are consumed the
+        # same iteration they are produced, so every row is staleness-0.
+        diag_acc = None
+        if cfg.learn_diag:
+            from tpu_rl.obs.learn import (
+                DiagAccumulator,
+                learn_record as _learn_record,
+                publish as _publish_diag,
+            )
+
+            diag_acc = DiagAccumulator()
+        stale0 = None
         log_every = max(1, cfg.loss_log_interval)
         it = self._start_it
         last_it, last_ep, last_ret = 0, 0, 0.0
@@ -539,6 +553,16 @@ class ColocatedLoop:
             state, carry, stats, metrics = self.program(
                 state, carry, stats, k_roll, k_train
             )
+            if diag_acc is not None and isinstance(metrics, dict):
+                diag = metrics.pop("diag", None)
+                if diag is not None:
+                    if stale0 is None:
+                        n_rows = (
+                            next(iter(diag["rows"].values())).shape[0]
+                            if diag["rows"] else 0
+                        )
+                        stale0 = jnp.zeros((n_rows,), jnp.float32)
+                    diag_acc.add(diag, stale0)
             if ledger is not None:
                 ledger.add(COMPUTE, time.perf_counter() - t_disp)
             it += 1
@@ -590,6 +614,18 @@ class ColocatedLoop:
             self._telemetry_tick(
                 it, it * n * s, episodes, ups, tps, chunk_s, mean_ret
             )
+            if diag_acc is not None:
+                diag_doc = diag_acc.drain(it)
+                if diag_doc is not None:
+                    if self.aggregator is not None:
+                        _publish_diag(self.aggregator.registry, diag_doc)
+                    if cfg.result_dir is not None:
+                        from tpu_rl.obs.audit import append_jsonl
+
+                        append_jsonl(
+                            cfg.result_dir, "learn.jsonl",
+                            _learn_record(it, diag_doc),
+                        )
             for name, val in host_metrics.items():
                 writer.add_scalar(f"loss/{name}", val, it)
             writer.add_scalar("colocated/env_steps_per_s", tps, it)

@@ -207,6 +207,12 @@ class LearnerService:
         # loss-log cadence) and watchdog-triggered rollbacks performed.
         self.n_nonfinite_updates = 0.0
         self.n_rollbacks = 0
+        # Learning-dynamics plane (tpu_rl.obs.learn): the on-device diag
+        # accumulator and the per-dispatch staleness sidecar FIFO (filled on
+        # the feeder thread, drained by the hot loop — same ordering as the
+        # prefetch queue). Both None unless Config.learn_diag.
+        self._diag = None
+        self._diag_vers = None
 
     # ------------------------------------------------------------------ run
     def run(self) -> None:
@@ -549,6 +555,26 @@ class LearnerService:
                 max_rollbacks=cfg.max_rollbacks,
                 window_s=cfg.rollback_window_s,
             )
+        # Learning-dynamics plane (tpu_rl.obs.learn): fold every dispatch's
+        # in-jit diag pytree into an on-device accumulator bucketed by the
+        # batch's policy staleness (the per-slot version sidecar the store
+        # reads back); host readback only at the loss-log cadence below.
+        # Must exist BEFORE the feed: the feeder thread's _assemble_device
+        # detaches the sidecar into _diag_vers.
+        diag_acc = diag_vers = None
+        _stale_rows = _learn_record = _publish_diag = None
+        if cfg.learn_diag:
+            from collections import deque as _deque
+
+            from tpu_rl.obs.learn import (
+                DiagAccumulator,
+                host_stale_rows as _stale_rows,
+                learn_record as _learn_record,
+                publish as _publish_diag,
+            )
+
+            diag_acc = self._diag = DiagAccumulator()
+            diag_vers = self._diag_vers = _deque()
         # The feed: a background prefetch pipeline (default) or the inline
         # synchronous path (learner_prefetch=0). Either way the loop below
         # pops ONE device-ready dispatch batch per iteration.
@@ -621,6 +647,22 @@ class LearnerService:
                     # Lazy device-side add — no host sync per dispatch; the
                     # loss-log branch below reads it back with float().
                     nf_acc = nf_acc + metrics["nonfinite-updates"]
+                if diag_acc is not None and isinstance(metrics, dict):
+                    # Detach diag BEFORE the loss logger's float() walk (it
+                    # is a nested pytree, not a scalar) and fold it with this
+                    # dispatch's per-row staleness — one async device
+                    # program, zero host syncs.
+                    diag = metrics.pop("diag", None)
+                    if diag is not None:
+                        vers = diag_vers.popleft() if diag_vers else None
+                        n_rows = (
+                            next(iter(diag["rows"].values())).shape[0]
+                            if diag["rows"]
+                            else 0
+                        )
+                        diag_acc.add(
+                            diag, _stale_rows(idx, vers, n_rows)
+                        )
                 if self._perf is not None:
                     # The dispatch critical path (same window as the
                     # learner-throughput timer) drives achieved FLOPs/s.
@@ -720,12 +762,40 @@ class LearnerService:
                         # metrics is already host-synced (block_until_ready
                         # above), so this read costs nothing extra.
                         self.n_nonfinite_updates = float(nf_acc)
+                    diag_doc = None
+                    if diag_acc is not None:
+                        # The plane's ONLY readback: derive the accumulated
+                        # sums into gauges + the learn.jsonl audit line,
+                        # then reset the on-device accumulator.
+                        diag_doc = diag_acc.drain(idx)
+                    if diag_doc is not None:
+                        if telem_reg is not None:
+                            _publish_diag(telem_reg, diag_doc)
+                        if cfg.result_dir is not None:
+                            from tpu_rl.obs.audit import append_jsonl
+
+                            append_jsonl(
+                                cfg.result_dir,
+                                "learn.jsonl",
+                                _learn_record(idx, diag_doc),
+                            )
                     if watchdog is not None:
                         sa_h = self.stat_array
                         signals = {
                             "loss": float(metrics["loss"]),
                             "grad-norm": float(metrics.get("grad-norm", 0.0)),
                         }
+                        if cfg.watchdog_diag and diag_doc is not None:
+                            # Algorithm-health channels: a KL spike is an
+                            # upward anomaly as-is; ESS collapses DOWNWARD,
+                            # so it enters negated to spike the z-score.
+                            g = diag_doc["global"]
+                            if "approx-kl" in g:
+                                signals["diag-approx-kl"] = float(
+                                    g["approx-kl"]
+                                )
+                            if "ess" in g:
+                                signals["diag-neg-ess"] = -float(g["ess"])
                         if (
                             sa_h is not None
                             and len(sa_h) > SLOT_MEAN_REW
@@ -907,6 +977,7 @@ class LearnerService:
 
         tracer = self._tracer
         t0 = time.perf_counter()
+        self._pop_vers(raws)
         batch = self._assemble(raws)
         t1 = time.perf_counter()
         if tracer is not None:
@@ -922,6 +993,24 @@ class LearnerService:
         if tracer is not None:
             tracer.add("h2d", t1, time.perf_counter() - t1, tid="feeder")
         return placed
+
+    def _pop_vers(self, raws: list) -> None:
+        """Detach each raw batch's ``"ver"`` staleness sidecar (a non-batch
+        key the Batch/multihost constructors must never see) and enqueue the
+        dispatch's concatenated per-row versions for the diag fold. Runs on
+        the feeder thread; the FIFO mirrors the feed queue's ordering
+        (single producer, single consumer)."""
+        vs = [
+            r.pop("ver", None) if isinstance(r, dict) else None for r in raws
+        ]
+        if self._diag_vers is None:
+            return
+        if any(v is None for v in vs):
+            self._diag_vers.append(None)
+        else:
+            self._diag_vers.append(
+                np.concatenate([np.asarray(v).reshape(-1) for v in vs])
+            )
 
     def _setup_multihost_feed(self, sharding) -> None:
         """On a multi-host mesh, each learner host feeds its OWN rows of the

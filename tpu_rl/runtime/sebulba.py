@@ -47,6 +47,7 @@ import time
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 
 from tpu_rl.config import Config
 from tpu_rl.parallel.mesh import (
@@ -270,6 +271,7 @@ class SebulbaLoop(ColocatedLoop):
         ):
             with self._lane_lock:
                 params = self._params_slot
+                pver = self._params_ver
             k = jax.random.fold_in(self._k_act_base, produced)
             t0 = time.perf_counter()
             carry, stats, batch = self.rollout(params, carry, stats, k)
@@ -286,7 +288,7 @@ class SebulbaLoop(ColocatedLoop):
             with self._lane_lock:
                 self._stats_slot = stats
             if not pipe.put(
-                (lbatch, stats), ledger=ledger, stop=self._lane_stop
+                (lbatch, stats, pver), ledger=ledger, stop=self._lane_stop
             ):
                 break
             produced += 1
@@ -329,11 +331,29 @@ class SebulbaLoop(ColocatedLoop):
         self._lane_stop = threading.Event()
         self._lane_lock = threading.Lock()
         self._params_slot = jax.device_put(act_params(state), self._act_rs)
+        # Learner version of the published acting params: every batch in the
+        # pipe is stamped with it, so the learner can attribute diagnostics
+        # to real policy staleness (bounded by queue depth, but measured,
+        # not assumed).
+        self._params_ver = self._start_it
         self._stats_slot = stats
         ledger = self.ledger
         if ledger is not None:
             from tpu_rl.obs.goodput import CKPT, COMPUTE, H2D
         metrics: Any = {}
+        # Learning-dynamics plane: same fold/drain as the fused loop, but
+        # each batch carries the REAL policy lag (learner updates applied
+        # since its acting params were published), so the by-staleness
+        # gauge families are live in the split too.
+        diag_acc = None
+        if cfg.learn_diag:
+            from tpu_rl.obs.learn import (
+                DiagAccumulator,
+                learn_record as _learn_record,
+                publish as _publish_diag,
+            )
+
+            diag_acc = DiagAccumulator()
         log_every = max(1, cfg.loss_log_interval)
         it = self._start_it
         last_it, last_ep, last_ret = 0, 0, 0.0
@@ -354,12 +374,23 @@ class SebulbaLoop(ColocatedLoop):
                 item = self._pipe.get(ledger=ledger, stop=self._stop)
                 if item is None:
                     break
-                batch, stats_ref = item
+                batch, stats_ref, bver = item
                 k_train = jax.random.fold_in(self._k_base, it)
                 if self._perf is not None:
                     self._perf.capture(self.train, state, batch, k_train)
                 t_disp = time.perf_counter()
                 state, metrics = self.train(state, batch, k_train)
+                if diag_acc is not None and isinstance(metrics, dict):
+                    diag = metrics.pop("diag", None)
+                    if diag is not None:
+                        n_rows = (
+                            next(iter(diag["rows"].values())).shape[0]
+                            if diag["rows"] else 0
+                        )
+                        stale = float(max(0, it - bver))
+                        diag_acc.add(
+                            diag, jnp.full((n_rows,), stale, jnp.float32)
+                        )
                 metrics = jax.block_until_ready(metrics)
                 t_done = time.perf_counter()
                 if ledger is not None:
@@ -371,6 +402,7 @@ class SebulbaLoop(ColocatedLoop):
                     ledger.add(H2D, time.perf_counter() - t_done)
                 with self._lane_lock:
                     self._params_slot = aparams
+                    self._params_ver = it + 1
                 it += 1
                 if self._heartbeat is not None:
                     self._heartbeat.value = time.time()
@@ -412,6 +444,18 @@ class SebulbaLoop(ColocatedLoop):
                 self._telemetry_tick(
                     it, it * n * s, episodes, ups, tps, chunk_s, mean_ret
                 )
+                if diag_acc is not None:
+                    diag_doc = diag_acc.drain(it)
+                    if diag_doc is not None:
+                        if self.aggregator is not None:
+                            _publish_diag(self.aggregator.registry, diag_doc)
+                        if cfg.result_dir is not None:
+                            from tpu_rl.obs.audit import append_jsonl
+
+                            append_jsonl(
+                                cfg.result_dir, "learn.jsonl",
+                                _learn_record(it, diag_doc),
+                            )
                 for name, val in host_metrics.items():
                     writer.add_scalar(f"loss/{name}", val, it)
                 writer.add_scalar("colocated/env_steps_per_s", tps, it)

@@ -786,13 +786,10 @@ class LearnerStorage:
             self.clocksync.add_one_way(key, t2, t3)
 
     def _flush(self, assembler: RolloutAssembler, store) -> None:
-        if self._tracer is not None:
-            windows, traces = assembler.pop_many_traced()
-        else:
-            windows, traces = assembler.pop_many(), None
+        windows, traces, vers = assembler.pop_many_full()
         if not windows:
             return
-        accepted = store.put_many(windows)
+        accepted = store.put_many(windows, vers=vers)
         self.n_windows += accepted
         if accepted < len(windows):
             # On-policy store full: the learner hasn't consumed yet. Requeue
@@ -801,6 +798,7 @@ class LearnerStorage:
             assembler.requeue(
                 windows[accepted:],
                 traces[accepted:] if traces is not None else None,
+                vers[accepted:],
             )
             self.n_requeue_full += 1
         if traces is not None:

@@ -82,11 +82,29 @@ def ensure_accelerator_or_cpu(
     return failure
 
 
+def cpu_count_override_supported() -> bool:
+    """True when this jax can re-size the CPU device count AFTER a backend
+    has already initialized (jax >= 0.5 exposes ``jax_num_cpu_devices``;
+    verified winning post-init on jax 0.9.0). Older jax burns the count in
+    at the process's FIRST ``XLA_FLAGS`` parse (first backend creation), so
+    ``force_cpu(n)`` can only honor ``n`` when it runs before that parse —
+    callers that need the virtual mesh in an already-initialized process
+    must check this and re-exec/subprocess instead."""
+    import jax
+
+    return hasattr(jax.config, "jax_num_cpu_devices")
+
+
 def force_cpu(n_devices: int | None = None) -> None:
     """Force this process onto the CPU backend, optionally with ``n_devices``
     virtual devices (for mesh tests / multichip dryruns).
 
     Safe to call before or after jax backend initialization; idempotent.
+    On jax < 0.5 the device-count request falls back to rewriting
+    ``XLA_FLAGS`` (``--xla_force_host_platform_device_count=N``), which wins
+    only if this process has not yet parsed XLA flags (i.e. no backend was
+    ever created); see :func:`cpu_count_override_supported`. A short count
+    raises instead of silently running on fewer devices.
     """
     import jax
 
@@ -98,9 +116,22 @@ def force_cpu(n_devices: int | None = None) -> None:
         pass  # very old/new jax: fall through, config update may still work
     jax.config.update("jax_platforms", "cpu")
     if n_devices is not None:
-        # Takes precedence over any --xla_force_host_platform_device_count
-        # in XLA_FLAGS (verified on jax 0.9.0).
-        jax.config.update("jax_num_cpu_devices", int(n_devices))
+        if cpu_count_override_supported():
+            # Takes precedence over any --xla_force_host_platform_device_count
+            # in XLA_FLAGS (verified on jax 0.9.0).
+            jax.config.update("jax_num_cpu_devices", int(n_devices))
+        else:
+            import os
+            import re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags
+            ).strip()
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} "
+                f"--xla_force_host_platform_device_count={int(n_devices)}"
+            ).strip()
         got = len(jax.devices())
         if got < int(n_devices):
             raise RuntimeError(
