@@ -5,7 +5,7 @@ PY ?= python
 .PHONY: lint format-check analyze typecheck test native-build protocol-matrix \
 	relay-smoke diag-smoke obs-smoke trace-smoke chaos-smoke colocated-smoke \
 	resume-smoke slo-smoke loadgen-smoke serving-smoke heal-smoke \
-	pbt-smoke goodput-smoke autopilot-smoke sebulba-smoke ci
+	pbt-smoke goodput-smoke autopilot-smoke sebulba-smoke history-smoke ci
 
 lint:
 	ruff check .
@@ -163,7 +163,18 @@ autopilot-smoke:
 sebulba-smoke:
 	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/sebulba_smoke.py
 
+# Run-history smoke (ISSUE 20): chaos-kill cluster run with the history
+# plane on — /query shows run progress, the report renders the chaos
+# event overlay, self-compare is green, and doctored candidates (dropped
+# channel / 20x slower) gate red. Includes the light history-overhead
+# bench (zero-alloc plane-off hot path; full capture:
+# TPU_RL_BENCH_HISTORY=1 python bench.py -> bench_history[.cpu].json).
+history-smoke:
+	JAX_PLATFORMS=cpu TPU_RL_BENCH_HISTORY=1 TPU_RL_BENCH_HISTORY_LIGHT=1 \
+		$(PY) bench.py > /dev/null
+	JAX_PLATFORMS=cpu PYTHONPATH=. $(PY) examples/history_smoke.py
+
 ci: lint analyze typecheck test protocol-matrix relay-smoke diag-smoke obs-smoke \
 	trace-smoke chaos-smoke colocated-smoke resume-smoke slo-smoke \
 	loadgen-smoke serving-smoke heal-smoke pbt-smoke goodput-smoke \
-	autopilot-smoke sebulba-smoke
+	autopilot-smoke sebulba-smoke history-smoke

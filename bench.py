@@ -1984,6 +1984,145 @@ def run_diag_compare(out_path: str | None = None) -> dict:
     return result
 
 
+# --------------------------------------------------- run-history overhead
+def run_history_compare(out_path: str | None = None) -> dict:
+    """Cost of the run-history plane on the exporter cadence: a synthetic
+    fleet's export tick (ingest every worker snapshot + JsonExporter
+    write) with ``TimeSeriesStore.record`` appended vs without. The
+    contract is the plane consumes <=2% of the exporter cadence budget
+    (``telemetry_interval_s`` wall seconds per tick) — the record call is
+    one flatten + one jsonl line, so the margin is wide even on a 1-core
+    CI box, and the assertion binds on every non-light capture.
+
+    The plane's OFF cost is pinned separately with tracemalloc: the hot
+    path with no store is ONE ``is None`` check, and the bench asserts
+    that loop allocates zero bytes (``off_path_alloc_bytes``).
+
+    ``TPU_RL_BENCH_HISTORY_LIGHT=1`` is the `make ci` smoke shape: tiny
+    budget, loose direction assert, nothing written."""
+    import shutil
+    import tempfile
+    import tracemalloc
+
+    from tpu_rl.obs import JsonExporter, TelemetryAggregator, TimeSeriesStore
+    from tpu_rl.obs.registry import MetricsRegistry
+
+    light = bool(os.environ.get("TPU_RL_BENCH_HISTORY_LIGHT"))
+    workers, ticks, repeats = (2, 20, 1) if light else (8, 200, 3)
+    interval_s = 2.0  # the repo-default exporter cadence the contract is
+    # measured against (Config.telemetry_interval_s)
+
+    def _fleet():
+        regs = []
+        for wid in range(workers):
+            reg = MetricsRegistry(
+                role="worker", labels={"wid": str(wid)}, pid=10_000 + wid
+            )
+            regs.append(reg)
+        return regs
+
+    def _tick(regs, agg, exporter, store, seq, t_wall):
+        for wid, reg in enumerate(regs):
+            reg.gauge("frame-rate").set(50.0 + seq % 7 + wid)
+            reg.counter("frames").set_total(float(100 * seq + wid))
+            reg.histogram("rtt-ms").observe(1.0 + (seq % 5) * 0.5)
+            agg.ingest(reg.snapshot())
+        exporter.maybe_export(now=float(seq))  # interval 0: always exports
+        if store is not None:
+            store.record(agg, now=t_wall)
+
+    rows = []
+    record_ms_best = None
+    for _ in range(repeats):
+        sides = {}
+        for history_on in (True, False):
+            tmp = tempfile.mkdtemp(prefix="bench_history_")
+            try:
+                regs = _fleet()
+                agg = TelemetryAggregator()
+                exporter = JsonExporter(
+                    agg, os.path.join(tmp, "telemetry.json"), interval_s=0.0
+                )
+                store = (
+                    TimeSeriesStore(
+                        os.path.join(tmp, "history"),
+                        chunk_s=60.0, retention_s=240.0,
+                    )
+                    if history_on else None
+                )
+                _tick(regs, agg, exporter, store, 0, 0.0)  # warm caches
+                t0 = time.perf_counter()
+                for seq in range(1, ticks + 1):
+                    # wall clock advances one cadence per tick, so chunk
+                    # rotation AND retention GC run inside the timed loop.
+                    _tick(regs, agg, exporter, store, seq, seq * interval_s)
+                elapsed_ms = (time.perf_counter() - t0) * 1e3
+                sides[history_on] = elapsed_ms / ticks
+                if store is not None:
+                    store.close()
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        record_ms = max(0.0, sides[True] - sides[False])
+        row = {
+            "tick_ms_on": round(sides[True], 4),
+            "tick_ms_off": round(sides[False], 4),
+            "record_ms": round(record_ms, 4),
+        }
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+        if record_ms_best is None or record_ms < record_ms_best:
+            record_ms_best = record_ms
+
+    # The plane-off pin: the per-tick hook reduces to `store is not None`,
+    # and that loop must allocate nothing.
+    gate = None
+    spins = (None,) * 10_000  # pre-built so the loop variable never allocates
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    for _ in spins:
+        if gate is not None:
+            gate.record(None)
+    off_path_alloc = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+
+    overhead_pct = record_ms_best / (interval_s * 1e3) * 100.0
+    result = {
+        "metric": "run-history record overhead per exporter tick, "
+                  "history on vs off",
+        "device_kind": jax.devices()[0].device_kind,
+        "workers": workers,
+        "ticks": ticks,
+        "repeats": repeats,
+        "interval_s": interval_s,
+        "record_ms": round(record_ms_best, 4),
+        "overhead_pct_of_cadence": round(overhead_pct, 4),
+        "contract_pct": 2.0,
+        # Unlike the chip benches, this is a host-side budget measured
+        # against a 2000ms cadence — the bar binds on every capture.
+        "contract_binding": True,
+        "off_path_alloc_bytes": int(off_path_alloc),
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "rows": rows,
+    }
+    assert off_path_alloc == 0, (
+        f"history-off hot path allocated {off_path_alloc} bytes: {result}"
+    )
+    if light:
+        # ci smoke: a catastrophic regression (a sync/fsync per append)
+        # shows up as 10x the budget, not a timer-noise wiggle.
+        assert overhead_pct < 20.0, result
+        return result
+    assert overhead_pct <= 2.0, (
+        f"history record above the 2% cadence contract: {result}"
+    )
+    if out_path is None:
+        on_cpu = jax.devices()[0].platform == "cpu"
+        out_path = "bench_history.cpu.json" if on_cpu else "bench_history.json"
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
 def _accelerator_reachable(timeout_s: float = 120.0) -> str | None:
     from tpu_rl.utils.platform import accelerator_reachable
 
@@ -2121,6 +2260,13 @@ if __name__ == "__main__":
         # contract for the in-jit diagnostics. TPU_RL_BENCH_DIAG_LIGHT=1 is
         # the `make ci` smoke shape.
         print(json.dumps(run_diag_compare()))
+        sys.exit(0)
+    if os.environ.get("TPU_RL_BENCH_HISTORY"):
+        # Run-history overhead A/B (ISSUE 20): the exporter tick with the
+        # TimeSeriesStore recording vs without — pins the <=2%-of-cadence
+        # record budget and the zero-alloc plane-off hot path.
+        # TPU_RL_BENCH_HISTORY_LIGHT=1 is the `make ci` smoke shape.
+        print(json.dumps(run_history_compare()))
         sys.exit(0)
     if os.environ.get("TPU_RL_BENCH_E2E"):
         # e2e feed A/B mode: sync vs prefetched LearnerService through the

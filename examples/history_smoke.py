@@ -1,0 +1,267 @@
+"""History smoke: boot the smallest real cluster with the run-history
+plane on (and a chaos worker kill mid-run), then drive every read surface
+the plane ships end-to-end:
+
+- the store exists under ``result_dir/history`` and rotated chunks on the
+  configured cadence;
+- the ``/query`` contract (the exact ``HistoryReader.http_query`` code
+  the HTTP server serves) lists series, returns raw points showing run
+  progress, and downsamples with ``step``;
+- the chaos kill is audited to ``chaos.jsonl`` inside the history span,
+  and ``python -m tpu_rl.obs.report`` renders it as an event overlay in
+  all three artifacts;
+- ``python -m tpu_rl.obs.compare`` run-vs-itself is green (exit 0), a
+  candidate doctored to DROP a recorded channel is red (exit 1 — no-data
+  gates, never silent-passes), and a candidate doctored 20x slower on
+  detectable throughput channels is flagged as regressed.
+
+Exits nonzero on any failure — this is the ``make history-smoke`` CI gate.
+
+Run:
+  JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python examples/history_smoke.py \
+      [--updates 8] [--base-port 28600]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rewrite_history(src: str, dst: str, transform) -> None:
+    """Copy a history dir chunk-by-chunk, mapping every row's sample dict
+    through ``transform`` (in place). series.json is copied verbatim —
+    an index entry whose points vanished is exactly the no-data shape
+    the compare gate must catch."""
+    os.makedirs(dst, exist_ok=True)
+    for fname in os.listdir(src):
+        s = os.path.join(src, fname)
+        if not fname.endswith(".jsonl"):
+            shutil.copy(s, os.path.join(dst, fname))
+            continue
+        with open(s) as f, open(os.path.join(dst, fname), "w") as out:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                transform(row.get("s") or {})
+                out.write(json.dumps(row, separators=(",", ":")) + "\n")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--updates", type=int, default=8)
+    p.add_argument("--base-port", type=int, default=28600)
+    p.add_argument("--timeout", type=float, default=300.0)
+    args = p.parse_args()
+
+    from tpu_rl.config import MachinesConfig, WorkerMachine
+    from tpu_rl.obs import compare, report
+    from tpu_rl.obs.history import HistoryReader
+    from tpu_rl.runtime.runner import local_cluster
+    from tests.conftest import small_config  # the CI-sized Config recipe
+
+    run_dir = tempfile.mkdtemp(prefix="history_smoke_")
+    cfg = small_config(
+        env="CartPole-v1",
+        algo="PPO",
+        worker_step_sleep=0.0,
+        learner_device="cpu",
+        rollout_lag_sec=30.0,
+        time_horizon=100,
+        loss_log_interval=2,
+        result_dir=run_dir,
+        telemetry_interval_s=0.5,
+        telemetry_stale_s=120.0,
+        supervise_poll_s=0.5,
+        history_chunk_s=5.0,
+        history_retention_s=600.0,
+        # fires once the fleet is warm — late enough that storage (slow
+        # jax import) has opened its first history chunk on most boxes
+        chaos_spec="kill:worker-0-1@t+12s",
+        chaos_seed=7,
+    )
+    machines = MachinesConfig(
+        learner_ip="127.0.0.1",
+        learner_port=args.base_port,
+        workers=[WorkerMachine(
+            num_p=2, manager_ip="127.0.0.1", ip="127.0.0.1",
+            port=args.base_port + 5,
+        )],
+    )
+    print(f"[history-smoke] cluster up; run_dir={run_dir}", flush=True)
+    sup = local_cluster(cfg, machines, max_updates=args.updates)
+    failures: list[str] = []
+    loop_thread = threading.Thread(target=sup.loop, daemon=True)
+    loop_thread.start()
+    try:
+        if not sup.stop_event.wait(args.timeout):
+            failures.append(
+                f"fleet did not complete within {args.timeout:.0f}s"
+            )
+        loop_thread.join(10.0)
+    finally:
+        sup.stop()
+
+    # ------------------------------------------------------- store + /query
+    hdir = os.path.join(run_dir, "history")
+    reader = HistoryReader(hdir)
+    if not reader.exists():
+        failures.append(f"no history store materialized under {hdir}")
+        for f in failures:
+            print(f"[history-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    n_chunks = len(reader._chunks())
+    print(f"[history-smoke] history chunks: {n_chunks}", flush=True)
+    if n_chunks < 1:
+        failures.append("history dir exists but holds no chunks")
+
+    status, listing = reader.http_query({})
+    series = [row["name"] for row in listing.get("series", ())]
+    if status != 200 or not series:
+        failures.append(f"/query series listing empty (status {status})")
+    ch = "learner/learner-update-index"
+    if ch not in series:
+        failures.append(f"{ch} missing from /query series listing")
+    status, doc = reader.http_query({"metric": ch})
+    pts = doc.get("points") or []
+    if status != 200 or len(pts) < 2:
+        failures.append(f"/query {ch}: {len(pts)} points, expected >= 2")
+    else:
+        values = [v for _t, v in pts]
+        if not (min(values) < max(values) and max(values) >= args.updates):
+            failures.append(
+                f"/query {ch} shows no run progress: {values[:8]}..."
+            )
+        else:
+            print(
+                f"[history-smoke] /query {ch}: {len(pts)} points, "
+                f"last={values[-1]:.0f}", flush=True,
+            )
+    status, down = reader.http_query({"metric": ch, "step": "2"})
+    if status != 200 or not down.get("buckets"):
+        failures.append("/query step=2 downsampling returned no buckets")
+
+    # ------------------------------------------------- chaos event + report
+    chaos_path = os.path.join(run_dir, "chaos.jsonl")
+    try:
+        chaos_events = [
+            json.loads(ln) for ln in open(chaos_path).read().splitlines()
+        ]
+    except OSError:
+        chaos_events = []
+    span = reader.span()
+    if not chaos_events:
+        failures.append("chaos.jsonl empty — the kill was never audited")
+    elif span is None or not (
+        # The supervisor's clock starts before storage finishes its (slow)
+        # boot, so the kill may precede the first recorded row by the boot
+        # latency — but it must land within the run, never after it.
+        span[0] - 60.0
+        <= chaos_events[0]["t"]
+        <= span[1] + cfg.history_chunk_s
+    ):
+        failures.append(
+            f"chaos event t={chaos_events[0]['t']:.1f} outside history "
+            f"span {span}"
+        )
+    else:
+        print(
+            "[history-smoke] chaos kill audited inside history span",
+            flush=True,
+        )
+
+    rc = report.main([run_dir])
+    if rc != 0:
+        failures.append(f"report CLI exited {rc}")
+    else:
+        md = open(os.path.join(run_dir, "report.md")).read()
+        html_text = open(os.path.join(run_dir, "report.html")).read()
+        rep = json.loads(open(os.path.join(run_dir, "report.json")).read())
+        if not any(ev["kind"] == "chaos" for ev in rep["events"]):
+            failures.append("report.json events carry no chaos event")
+        if "chaos" not in md or "chaos" not in html_text:
+            failures.append("chaos event not rendered in report.md/html")
+        if not rep["channels"]:
+            failures.append("report charted zero channels")
+
+    # -------------------------------------------------------------- compare
+    rc = compare.main([run_dir, run_dir])
+    if rc != 0:
+        failures.append(f"self-compare exited {rc}, expected 0 (green)")
+
+    # Doctored candidate 1: drop the recorded update-index channel
+    # entirely. Missing data must gate — exit 1, never a silent pass.
+    dropped = os.path.join(run_dir, "doctored_dropped")
+    _rewrite_history(hdir, dropped, lambda s: s.pop(ch, None))
+    rc = compare.main([run_dir, dropped])
+    if rc != 1:
+        failures.append(
+            f"compare vs channel-dropped candidate exited {rc}, expected 1"
+        )
+    else:
+        print("[history-smoke] dropped-channel candidate gated red", flush=True)
+
+    # Doctored candidate 2: 20x slower on every direction-ful channel
+    # whose baseline is stable enough for the MAD band to resolve a 95%
+    # drop (a genuinely noisy micro-run channel widening its own band is
+    # the tool working as specified, not a miss).
+    detectable = []
+    for name in series:
+        if compare.direction(name) != "up":
+            continue
+        vals = compare.trim_warmup(reader.points(name))
+        if len(vals) < compare.MIN_SAMPLES:
+            continue
+        med, sigma = compare.robust_stats(vals)
+        band = max(compare.MAD_K * sigma, compare.REL_TOL * abs(med))
+        if med > 0 and band < 0.9 * med:
+            detectable.append(name)
+    if detectable:
+        slow = os.path.join(run_dir, "doctored_slow")
+
+        def _slowdown(s):
+            for name in detectable:
+                if name in s:
+                    s[name] = s[name] * 0.05
+
+        _rewrite_history(hdir, slow, _slowdown)
+        doc = compare.compare_runs(hdir, slow)
+        regressed = [
+            r["channel"] for r in doc["rows"] if r["verdict"] == "regressed"
+        ]
+        if doc["ok"] or not regressed:
+            failures.append(
+                f"slow candidate not flagged: detectable={detectable} "
+                f"counts={doc['counts']}"
+            )
+        else:
+            print(
+                f"[history-smoke] slow candidate regressed on "
+                f"{len(regressed)}/{len(detectable)} channels", flush=True,
+            )
+    else:
+        print(
+            "[history-smoke] no band-resolvable throughput channel this "
+            "run; slow-doctor check skipped (dropped-channel gate above "
+            "still pins red)", flush=True,
+        )
+
+    if failures:
+        for f in failures:
+            print(f"[history-smoke] FAIL: {f}", file=sys.stderr, flush=True)
+        return 1
+    print("[history-smoke] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -178,3 +178,36 @@ def test_committed_diag_overhead_record():
         assert r["overhead_pct"] < 50.0, r
     if rec["contract_binding"]:
         assert rec["max_overhead_pct"] <= rec["contract_pct"], rec
+
+
+def test_committed_history_overhead_record():
+    """The committed run-history A/B record (ISSUE 20,
+    ``run_history_compare``) must parse with the full schema and hold
+    both contracts on every capture regime: the store's record call
+    consumes <=2% of the exporter cadence budget (a host-side wall
+    budget — binding even on CPU captures, unlike the chip benches), and
+    the plane-off hot path allocates zero bytes (the one-``is None``
+    -check cost model the telemetry plane itself ships with)."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(bench.__file__)),
+        "bench_history.cpu.json",
+    )
+    with open(path) as f:
+        rec = json.load(f)
+    for key in (
+        "metric", "device_kind", "workers", "ticks", "repeats",
+        "interval_s", "record_ms", "overhead_pct_of_cadence",
+        "contract_pct", "contract_binding", "off_path_alloc_bytes",
+        "recorded_at", "rows",
+    ):
+        assert key in rec, f"missing key: {key}"
+    assert rec["contract_pct"] == 2.0
+    assert rec["interval_s"] > 0
+    assert rec["workers"] >= 1 and rec["ticks"] >= 1
+    assert len(rec["rows"]) == rec["repeats"]
+    for r in rec["rows"]:
+        assert r["tick_ms_on"] > 0 and r["tick_ms_off"] > 0
+        assert r["record_ms"] >= 0
+    assert rec["off_path_alloc_bytes"] == 0, rec
+    assert rec["contract_binding"] is True
+    assert rec["overhead_pct_of_cadence"] <= rec["contract_pct"], rec

@@ -268,6 +268,27 @@ def test_build_frame_golden():
     empty = top.build_frame([], None, None)
     assert any("no goodput gauges yet" in ln for ln in empty)
     assert any("no /slo endpoint" in ln for ln in empty)
+    # History plane off (history=None) renders byte-identical to the
+    # default call — blank sparklines, never placeholders.
+    assert top.build_frame(
+        samples, goodput_doc, slo_doc, url="http://x/m", history=None
+    ) == lines
+    # With history, the matching panels gain trend lines.
+    sparked = top.build_frame(
+        samples, goodput_doc, slo_doc, url="http://x/m",
+        history={
+            "learner-goodput-ratio": [0.5, 0.6, 0.7],
+            "learner-throughput": [100.0, 200.0, 150.0],
+        },
+    )
+    text2 = "\n".join(sparked)
+    assert top.SPARK_BLOCKS[0] in text2 and top.SPARK_BLOCKS[-1] in text2
+    assert any(
+        ln.startswith("  learner ") and "70.0%" in ln
+        and any(c in top.SPARK_BLOCKS for c in ln) for ln in sparked
+    )
+    assert any(ln.strip().startswith("learner tps") and "▁" in ln
+               for ln in sparked if "12,345" not in ln)
 
 
 def test_top_bar_and_parse_prometheus():
@@ -299,7 +320,7 @@ def test_top_loop_renders_one_frame_with_mock_terminal():
     )
     with mock.patch.object(
         top, "collect",
-        return_value=(samples, goodput_doc, slo_doc, None, False),
+        return_value=(samples, goodput_doc, slo_doc, None, None, False),
     ):
         assert top._loop(stdscr, args) == 0
     stdscr.erase.assert_called()

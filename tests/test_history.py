@@ -1,0 +1,612 @@
+"""Run-history plane tests (ISSUE 20): the embedded TimeSeriesStore's
+rotation / retention / crash-atomicity contracts, the ``/query``
+downsampling grammar (pure and over real HTTP), the compare CLI's
+verdict matrix against the committed baseline capture, the online
+anomaly detector's trip conditions, the report artifacts' schema, and
+autopilot signal rehydration across a restart."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import small_config
+from tpu_rl.obs import (
+    AnomalyDetector,
+    HistoryReader,
+    MetricsRegistry,
+    TelemetryAggregator,
+    TelemetryHTTPServer,
+    TimeSeriesStore,
+    channel_name,
+    downsample,
+    flatten_snapshots,
+    history_path,
+    maybe_history,
+)
+from tpu_rl.obs import compare, report
+from tpu_rl.obs.anomaly import (
+    ANOMALY_LEVEL_SHIFTS_METRIC,
+    ANOMALY_SPIKES_METRIC,
+)
+
+BASELINE_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data", "history_baseline"
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------- flattening
+def test_channel_name_drops_identity_labels_and_sorts_tail():
+    assert channel_name("worker", "frame-rate") == "worker/frame-rate"
+    assert channel_name(
+        "worker", "frame-rate", {"pid": "7", "role": "worker", "wid": "3"}
+    ) == "worker/frame-rate{wid=3}"
+    assert channel_name(
+        "x", "m", {"b": "2", "a": "1"}
+    ) == "x/m{a=1,b=2}"
+
+
+def test_flatten_snapshots_gauge_wins_counter_sums_hist_quantiles():
+    snaps = [
+        (
+            {
+                "role": "worker",
+                "gauges": [["frame-rate", {"wid": "0"}, 10.0]],
+                "counters": [["frames", {}, 5.0]],
+                "hists": [],
+            },
+            0.0,
+        ),
+        (
+            {
+                "role": "worker",
+                "gauges": [["frame-rate", {"wid": "0"}, 20.0]],
+                "counters": [["frames", {}, 7.0]],
+                # empty hist: contributes no quantile channels (explicit
+                # no-data, never a fabricated zero)
+                "hists": [["rtt", {}, [0] * 31, 0.0, 0]],
+            },
+            0.0,
+        ),
+    ]
+    samples, kinds = flatten_snapshots(snaps)
+    assert samples["worker/frame-rate{wid=0}"] == 20.0  # last write wins
+    assert samples["worker/frames"] == 12.0  # counters sum across sources
+    assert kinds["worker/frames"] == "counter"
+    assert not any("rtt" in ch for ch in samples)
+
+    reg = MetricsRegistry(role="learner", pid=1)
+    reg.histogram("lat").observe(1.0)
+    reg.histogram("lat").observe(1.0)
+    samples, kinds = flatten_snapshots([(reg.snapshot(), 0.0)])
+    assert "learner/lat-p50" in samples and "learner/lat-p99" in samples
+    assert kinds["learner/lat-p50"] == "quantile"
+
+
+def test_downsample_golden():
+    pts = [(0.0, 1.0), (1.0, 3.0), (2.5, 5.0), (3.0, 7.0)]
+    rows = downsample(pts, 2.0, start=0.0)
+    assert rows == [
+        {"t": 0.0, "n": 2, "min": 1.0, "max": 3.0, "last": 3.0, "mean": 2.0},
+        {"t": 2.0, "n": 2, "min": 5.0, "max": 7.0, "last": 7.0, "mean": 6.0},
+    ]
+    # Bucket alignment follows `start`; the same step over a shifted start
+    # yields shifted bucket edges.
+    assert downsample(pts, 2.0, start=-1.0)[0]["t"] == -1.0
+    assert downsample([], 2.0) == []
+
+
+# ------------------------------------------------------ rotation/retention
+def test_store_rotates_chunks_and_gcs_past_retention(tmp_path):
+    clock = FakeClock(100.0)
+    store = TimeSeriesStore(
+        str(tmp_path), chunk_s=10.0, retention_s=25.0, clock=clock
+    )
+    for i in range(5):
+        clock.t = 100.0 + 10.0 * i
+        store.append({"r/x": float(i)}, kinds={"r/x": "gauge"})
+    assert store.n_rotated == 4
+    # t=140: horizon 115; chunks starting at 100 (covers to 110) die,
+    # 110-start (covers to 120) survives.
+    names = sorted(os.listdir(str(tmp_path)))
+    assert "chunk-000000000100000.jsonl" not in names
+    assert "chunk-000000000110000.jsonl" in names
+    assert store.n_gc >= 1
+    # Everything still on disk reads back in order.
+    assert [v for _t, v in store.points("r/x")] == [1.0, 2.0, 3.0, 4.0]
+    store.close()
+
+
+def test_store_resume_inherits_series_index(tmp_path):
+    clock = FakeClock(0.0)
+    store = TimeSeriesStore(str(tmp_path), clock=clock)
+    store.append({"r/a": 1.0}, kinds={"r/a": "gauge"})
+    store.close()
+    store2 = TimeSeriesStore(str(tmp_path), clock=clock)
+    assert store2.series().get("r/a") == "gauge"
+    store2.close()
+
+
+def test_torn_tail_line_is_invisible(tmp_path):
+    clock = FakeClock(0.0)
+    store = TimeSeriesStore(str(tmp_path), clock=clock)
+    store.append({"r/x": 1.0}, kinds={"r/x": "gauge"})
+    store.append({"r/x": 2.0})
+    store.close()
+    chunk = next(
+        p for p in tmp_path.iterdir() if p.name.startswith("chunk-")
+    )
+    with open(chunk, "a") as f:
+        f.write('{"t": 3.0, "s": {"r/x": 99')  # crash mid-write
+    reader = HistoryReader(str(tmp_path))
+    assert [v for _t, v in reader.points("r/x")] == [1.0, 2.0]
+    # Non-dict and unstamped rows are skipped the same way.
+    with open(chunk, "a") as f:
+        f.write("\n[1,2]\n{\"s\": {\"r/x\": 5}}\n")
+    assert [v for _t, v in reader.points("r/x")] == [1.0, 2.0]
+
+
+def test_reader_series_falls_back_to_chunk_scan(tmp_path):
+    clock = FakeClock(0.0)
+    store = TimeSeriesStore(str(tmp_path), clock=clock)
+    store.append({"r/x": 1.0}, kinds={"r/x": "gauge"})
+    store.close()
+    os.remove(tmp_path / "series.json")  # index torn away by a crash
+    reader = HistoryReader(str(tmp_path))
+    assert reader._chunk_s_hint() is None
+    assert reader.series() == {"r/x": "unknown"}
+    # Without the chunk_s hint no chunk is skipped on start-bounded reads.
+    assert reader.points("r/x", start=0.0) == [(0.0, 1.0)]
+
+
+def test_chunk_s_hint_bounds_skip_without_single_writer_assumption(tmp_path):
+    # Writer A's chunk starts at t=0 and covers rows through t=9; writer
+    # B's chunk (same dir) starts at t=2. A start=8 query must still read
+    # chunk A — its coverage is bounded by chunk_s, not by B's start.
+    clock_a, clock_b = FakeClock(0.0), FakeClock(2.0)
+    a = TimeSeriesStore(str(tmp_path), chunk_s=10.0, clock=clock_a)
+    b = TimeSeriesStore(str(tmp_path), chunk_s=10.0, clock=clock_b)
+    a.append({"r/a": 1.0}, kinds={"r/a": "gauge"})
+    b.append({"r/b": 1.0}, kinds={"r/b": "gauge"})
+    clock_a.t = 9.0
+    a.append({"r/a": 2.0})
+    a.close()
+    b.close()
+    reader = HistoryReader(str(tmp_path))
+    assert reader._chunk_s_hint() == 10.0
+    assert reader.points("r/a", start=8.0) == [(9.0, 2.0)]
+
+
+def test_record_feeds_from_aggregator_and_publishes_own_counters(tmp_path):
+    agg = TelemetryAggregator()
+    agg.registry.gauge("storage-queue-depth").set(4.0)
+    clock = FakeClock(50.0)
+    store = TimeSeriesStore(str(tmp_path), clock=clock)
+    samples = store.record(agg, extra={"signals/burn:x": 1.5})
+    assert samples["storage/storage-queue-depth"] == 4.0
+    assert samples["signals/burn:x"] == 1.5
+    assert store.series()["signals/burn:x"] == "signal"
+    assert agg.registry.counter("history-rows").value == 1.0
+    assert store.points("signals/burn:x") == [(50.0, 1.5)]
+    store.close()
+
+
+# ---------------------------------------------------------------- gating
+def test_history_path_and_maybe_history_gating(tmp_path):
+    cfg = small_config()
+    assert cfg.result_dir is None and history_path(cfg) is None
+    assert maybe_history(cfg) is None  # telemetry plane off -> no store
+    cfg = small_config(result_dir=str(tmp_path))
+    assert history_path(cfg) == str(tmp_path / "history")
+    store = maybe_history(cfg)
+    assert isinstance(store, TimeSeriesStore)
+    assert store.anomaly is not None
+    store.close()
+    cfg = small_config(
+        result_dir=str(tmp_path), history_dir=str(tmp_path / "elsewhere")
+    )
+    assert history_path(cfg) == str(tmp_path / "elsewhere")
+
+
+def test_config_validates_history_knobs(tmp_path):
+    small_config(history_chunk_s=60.0, history_retention_s=3600.0).validate()
+    with pytest.raises(AssertionError):
+        small_config(history_chunk_s=0.0).validate()
+    with pytest.raises(AssertionError):
+        small_config(
+            history_chunk_s=120.0, history_retention_s=60.0
+        ).validate()
+
+
+# ----------------------------------------------------------------- /query
+def _query_fixture(tmp_path):
+    clock = FakeClock(0.0)
+    store = TimeSeriesStore(str(tmp_path), clock=clock)
+    for i in range(10):
+        clock.t = float(i)
+        store.append(
+            {"r/x": float(i), "r/y": 1.0},
+            kinds={"r/x": "gauge", "r/y": "counter"},
+        )
+    store.close()
+    return HistoryReader(str(tmp_path))
+
+
+def test_http_query_contract(tmp_path):
+    reader = _query_fixture(tmp_path)
+    status, doc = reader.http_query({})
+    assert status == 200
+    assert doc["series"] == [
+        {"name": "r/x", "kind": "gauge"},
+        {"name": "r/y", "kind": "counter"},
+    ]
+    status, doc = reader.http_query({"metric": "r/x", "start": "2", "end": "4"})
+    assert status == 200 and doc["n"] == 3
+    assert doc["points"] == [[2.0, 2.0], [3.0, 3.0], [4.0, 4.0]]
+    status, doc = reader.http_query({"metric": "r/x", "step": "5"})
+    assert status == 200 and [b["n"] for b in doc["buckets"]] == [5, 5]
+    assert doc["buckets"][1]["mean"] == 7.0
+    status, doc = reader.http_query({"metric": "r/x", "start": "nope"})
+    assert status == 400
+    status, doc = reader.http_query({"metric": "r/x", "step": "-1"})
+    assert status == 400
+    status, doc = reader.http_query({"metric": "absent"})
+    assert status == 200 and doc["n"] == 0 and doc["points"] == []
+
+
+@pytest.mark.timeout(30)
+def test_http_query_endpoint_end_to_end(tmp_path):
+    agg = TelemetryAggregator()
+    srv = TelemetryHTTPServer(agg, port=0)  # history not wired
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/query", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+    reader = _query_fixture(tmp_path)
+    srv = TelemetryHTTPServer(agg, port=0, query=reader.http_query)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/query", timeout=5) as r:
+            assert json.loads(r.read())["series"][0]["name"] == "r/x"
+        with urllib.request.urlopen(
+            f"{base}/query?metric=r%2Fx&start=2&end=4&step=2", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+            assert doc["step"] == 2.0 and len(doc["buckets"]) == 2
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{base}/query?metric=r%2Fx&start=bad", timeout=5
+            )
+        assert ei.value.code == 400
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- compare
+def test_compare_channel_verdict_matrix():
+    base = [100.0, 101.0, 99.0, 100.0, 102.0]
+    up, down = "r/x-per-s", "r/frame-rtt-ms"
+    assert compare.direction(up) == "up"
+    assert compare.direction(down) == "down"
+    assert compare.direction("r/knob") == "neutral"
+    assert compare.compare_channel(base, base, up)["verdict"] == "ok"
+    assert compare.compare_channel(
+        base, [50.0] * 5, up
+    )["verdict"] == "regressed"
+    assert compare.compare_channel(
+        base, [200.0] * 5, up
+    )["verdict"] == "improved"
+    assert compare.compare_channel(
+        base, [200.0] * 5, down
+    )["verdict"] == "regressed"
+    assert compare.compare_channel(
+        base, [200.0] * 5, "r/knob"
+    )["verdict"] == "shifted"
+    row = compare.compare_channel(base, None, up)
+    assert row["verdict"] == "no-data"
+    assert compare.compare_channel(base, [5.0], up)["verdict"] == "no-data"
+    assert compare.compare_channel(None, base, up)["verdict"] == "new"
+    # both-empty never gates: a run compared to itself must be green
+    assert compare.compare_channel(None, None, up)["verdict"] == "skipped"
+    # The relative floor keeps a quiet channel's band non-degenerate.
+    quiet = [100.0] * 5
+    row = compare.compare_channel(quiet, [95.0] * 5, up)
+    assert row["verdict"] == "ok" and row["band"] == 10.0
+
+
+def test_trim_warmup_is_time_based():
+    pts = [(0.0, 1.0), (1.0, 2.0), (9.0, 3.0), (10.0, 4.0)]
+    assert compare.trim_warmup(pts, 0.2) == [3.0, 4.0]
+    assert compare.trim_warmup([], 0.2) == []
+
+
+def test_compare_against_committed_baseline(tmp_path, capsys):
+    # Self-compare of the committed capture must be green.
+    assert compare.main([BASELINE_DIR, BASELINE_DIR]) == 0
+    assert "OK" in capsys.readouterr().out
+
+    # A candidate doctored 2x slower on the throughput channel gates red.
+    slow = tmp_path / "slow"
+    slow.mkdir()
+    for fname in os.listdir(BASELINE_DIR):
+        src = os.path.join(BASELINE_DIR, fname)
+        if not fname.startswith("chunk-"):
+            with open(slow / fname, "w") as out:
+                out.write(open(src).read())
+            continue
+        with open(src) as f, open(slow / fname, "w") as out:
+            for line in f:
+                row = json.loads(line)
+                ch = "learner/learner-updates-per-s"
+                row["s"][ch] = row["s"][ch] * 0.5
+                out.write(json.dumps(row) + "\n")
+    assert compare.main([BASELINE_DIR, str(slow)]) == 1
+    out = capsys.readouterr().out
+    assert "regressed" in out and "learner-updates-per-s" in out
+
+    doc = compare.compare_runs(BASELINE_DIR, str(slow))
+    verdicts = {r["channel"]: r["verdict"] for r in doc["rows"]}
+    assert verdicts["learner/learner-updates-per-s"] == "regressed"
+    assert verdicts["learner/learner-lr"] == "ok"
+    assert not doc["ok"]
+
+    # A candidate missing a recorded channel is explicit no-data: red.
+    dropped = tmp_path / "dropped"
+    dropped.mkdir()
+    for fname in os.listdir(BASELINE_DIR):
+        src = os.path.join(BASELINE_DIR, fname)
+        if not fname.startswith("chunk-"):
+            with open(dropped / fname, "w") as out:
+                out.write(open(src).read())
+            continue
+        with open(src) as f, open(dropped / fname, "w") as out:
+            for line in f:
+                row = json.loads(line)
+                row["s"].pop("learner/learner-updates-per-s", None)
+                out.write(json.dumps(row) + "\n")
+    assert compare.main([BASELINE_DIR, str(dropped)]) == 1
+    assert "no-data" in capsys.readouterr().out
+
+    # Missing store entirely: exit 2 (usage error, not a verdict).
+    assert compare.main([BASELINE_DIR, str(tmp_path / "nothing")]) == 2
+
+
+# ---------------------------------------------------------------- anomaly
+def test_anomaly_spike_trips_and_is_clamped():
+    det = AnomalyDetector()
+    kinds = {"r/x": "gauge"}
+    for _ in range(20):
+        assert det.observe({"r/x": 100.0 + 0.01}, kinds) == []
+    events = det.observe({"r/x": 10_000.0}, kinds)
+    assert events == [("r/x", "spike")]
+    # The spike fold is clamped: the next normal sample is NOT an anomaly
+    # in the other direction (mean was not dragged to 10k).
+    assert det.observe({"r/x": 100.0}, kinds) == []
+
+
+def test_anomaly_level_shift_needs_sustain():
+    det = AnomalyDetector()
+    kinds = {"r/x": "gauge"}
+    for i in range(30):
+        det.observe({"r/x": 100.0 + (i % 3) * 0.5}, kinds)
+    # 102.5 sits between the level (3 sigma) and spike (8 sigma) bars for
+    # this trace's dispersion: only a sustained streak may fire.
+    fired = []
+    for _ in range(10):
+        fired += det.observe({"r/x": 102.5}, kinds)
+    assert ("r/x", "level-shift") in fired
+    assert ("r/x", "spike") not in fired
+    # One stray out-of-band sample (streak broken) never fires.
+    det2 = AnomalyDetector()
+    for i in range(30):
+        det2.observe({"r/x": 100.0 + (i % 3) * 0.5}, kinds)
+    assert det2.observe({"r/x": 102.5}, kinds) == []
+    assert det2.observe({"r/x": 100.0}, kinds) == []
+    assert det2.observe({"r/x": 102.5}, kinds) == []
+
+
+def test_anomaly_slow_drift_never_trips_and_counters_skipped():
+    det = AnomalyDetector()
+    kinds = {"r/x": "gauge", "r/c": "counter"}
+    x = 100.0
+    for i in range(500):
+        x *= 1.001  # 0.1%/tick drift: the EWMA tracks it
+        # counters ratchet by construction — never anomaly material
+        assert det.observe({"r/x": x, "r/c": float(i * 1000)}, kinds) == []
+
+
+def test_anomaly_publishes_slo_able_counters():
+    det = AnomalyDetector()
+    reg = MetricsRegistry(role="storage")
+    kinds = {"r/x": "gauge"}
+    for _ in range(20):
+        det.observe({"r/x": 100.0}, kinds, registry=reg)
+    det.observe({"r/x": 10_000.0}, kinds, registry=reg)
+    spike = reg.counter(ANOMALY_SPIKES_METRIC, labels={"channel": "r/x"})
+    assert spike.value == 1.0
+    shifts = reg.counter(
+        ANOMALY_LEVEL_SHIFTS_METRIC, labels={"channel": "r/x"}
+    )
+    assert shifts.value == 0.0
+
+
+# ----------------------------------------------------------------- report
+def test_report_schema_markdown_html_and_events(tmp_path):
+    run = tmp_path / "run"
+    run.mkdir()
+    clock = FakeClock(1000.0)
+    store = TimeSeriesStore(str(run / "history"), clock=clock)
+    for i in range(12):
+        clock.t = 1000.0 + i
+        store.append(
+            {
+                "colocated/colocated-env-steps-per-s": 50.0 + i,
+                "learner/learner-update-index": float(i),
+                "r/uncharted": 1.0,
+            },
+            kinds={
+                "colocated/colocated-env-steps-per-s": "gauge",
+                "learner/learner-update-index": "gauge",
+                "r/uncharted": "gauge",
+            },
+        )
+    store.close()
+    with open(run / "chaos.jsonl", "w") as f:
+        f.write(json.dumps(
+            {"ev": "chaos", "action": "kill", "target": "worker-0-1",
+             "t": 1004.0}
+        ) + "\n")
+        f.write('{"torn')  # crash mid-append: skipped
+    with open(run / "learner_rollback.jsonl", "w") as f:
+        f.write(json.dumps({"idx": 6, "epoch": 1, "t": 1006.0}) + "\n")
+
+    doc = report.build_report(str(run))
+    assert doc["n_series"] == 3
+    names = [ch["name"] for ch in doc["channels"]]
+    assert names == [
+        "colocated/colocated-env-steps-per-s",
+        "learner/learner-update-index",
+    ]  # default patterns chart the health set, not every channel
+    ch = doc["channels"][0]
+    for key in ("kind", "n", "t0", "t1", "mean", "min", "max", "last"):
+        assert key in ch
+    assert [e["kind"] for e in doc["events"]] == ["chaos", "rollback"]
+    assert doc["events"][0]["label"] == "kill:worker-0-1"
+    assert doc["events"][1]["label"] == "idx=6@e1"
+
+    md = report.render_markdown(doc)
+    assert "| `colocated/colocated-env-steps-per-s` |" in md
+    assert "chaos" in md and "kill:worker-0-1" in md
+    html_text = report.render_html(
+        doc, HistoryReader(str(run / "history"))
+    )
+    assert "<svg" in html_text and "polyline" in html_text
+    assert "chaos: kill:worker-0-1" in html_text
+
+    assert report.main([str(run)]) == 0
+    for fname in ("report.json", "report.md", "report.html"):
+        assert (run / fname).is_file()
+    assert json.loads((run / "report.json").read_text())["channels"]
+    # No history store: explicit error exit, never an empty "healthy" doc.
+    assert report.main([str(tmp_path / "empty")]) == 2
+
+
+# ---------------------------------------------------- signal rehydration
+def test_rehydrate_signals_restores_all_kinds_across_restart(tmp_path):
+    from tpu_rl.autopilot.signals import (
+        SignalStore,
+        rehydrate_signals,
+        signal_channels,
+    )
+
+    # First controller life: scraped signals persisted on the exporter
+    # cadence as signals/<key> channels.
+    mono = FakeClock(100.0)
+    live = SignalStore(window_s=60.0, clock=mono)
+    wall = FakeClock(5000.0)
+    store = TimeSeriesStore(str(tmp_path), clock=wall)
+    for i in range(5):
+        mono.t = 100.0 + 10.0 * i
+        wall.t = 5000.0 + 10.0 * i
+        for key, v in (
+            ("burn:frames", 0.1 * i),
+            ("goodput:learner", 0.8),
+            ("gauge:learner-mfu", 0.3),
+            ("counter:anomaly-spikes", float(i)),
+        ):
+            live.put(key, v)
+        # Mirror TimeSeriesStore.record(extra=...): signal channels are
+        # indexed with kind "signal" so rehydration can discover them.
+        chans = signal_channels(live)
+        store.append(
+            {**chans, "storage/other": 1.0},
+            kinds={**{ch: "signal" for ch in chans},
+                   "storage/other": "gauge"},
+        )
+    store.close()
+
+    # Restart: a fresh store rehydrates every signal kind — not just the
+    # burn rates the /slo replay covers.
+    mono2 = FakeClock(150.0)
+    fresh = SignalStore(window_s=60.0, clock=mono2)
+    n = rehydrate_signals(
+        fresh, HistoryReader(str(tmp_path)),
+        now_wall=5045.0, now_mono=150.0,
+    )
+    assert n > 0
+    assert fresh.latest("burn:frames") == pytest.approx(0.4)
+    assert fresh.latest("goodput:learner") == 0.8
+    assert fresh.latest("counter:anomaly-spikes") == 4.0
+    assert "storage/other" not in fresh.snapshot()  # non-signal channels
+    # Window math: only samples inside window_s of now_wall restored,
+    # converted to the monotonic clock.
+    ts = [t for t, _v in fresh.series("burn:frames")]
+    assert all(90.0 <= t <= 150.0 for t in ts)
+    # Live puts after rehydration are NOT blocked by the monotonic guard.
+    mono2.t = 151.0
+    fresh.put("burn:frames", 0.9)
+    assert fresh.latest("burn:frames") == 0.9
+
+
+def test_rehydrate_drops_future_samples(tmp_path):
+    from tpu_rl.autopilot.signals import SignalStore, rehydrate_signals
+
+    wall = FakeClock(1000.0)
+    store = TimeSeriesStore(str(tmp_path), clock=wall)
+    store.append({"signals/burn:x": 1.0}, kinds={"signals/burn:x": "signal"})
+    wall.t = 2000.0  # cross-boot clock step: lands beyond "now"
+    store.append({"signals/burn:x": 7.0})
+    store.close()
+    fresh = SignalStore(window_s=1e6, clock=FakeClock(50.0))
+    rehydrate_signals(
+        fresh, HistoryReader(str(tmp_path)), now_wall=1005.0, now_mono=50.0
+    )
+    assert fresh.latest("signals/burn:x".removeprefix("signals/")) == 1.0
+
+
+# -------------------------------------------------------------- sparklines
+def test_sparkline_and_collect_history():
+    from tpu_rl.obs import top
+
+    assert top.sparkline([]) == ""
+    assert top.sparkline([5.0, 5.0]) == top.SPARK_BLOCKS[3] * 2
+    ramp = top.sparkline([float(i) for i in range(8)])
+    assert ramp == top.SPARK_BLOCKS
+    assert len(top.sparkline(list(range(1000)))) == top._SPARK_WIDTH
+
+    def fake_fetch_json(url, timeout=2.0):
+        if url.endswith("/query"):
+            return {"series": [
+                {"name": "learner/learner-mfu", "kind": "gauge"},
+                {"name": "worker/frame-rate{wid=1}", "kind": "gauge"},
+                {"name": "storage/uninteresting", "kind": "gauge"},
+            ]}
+        assert "metric=learner%2Flearner-mfu" in url
+        return {"points": [[1.0, 0.2], [2.0, 0.4]]}
+
+    hist = top.collect_history("http://x", fetch_json_fn=fake_fetch_json)
+    assert hist == {"learner-mfu": [0.2, 0.4]}  # labeled + unmatched skipped
+    # Plane off (404 error body) -> None -> panels render blank.
+    assert top.collect_history(
+        "http://x", fetch_json_fn=lambda u, t=2.0: {"error": "nope"}
+    ) is None
+    assert top.collect_history(
+        "http://x", fetch_json_fn=lambda u, t=2.0: None
+    ) is None

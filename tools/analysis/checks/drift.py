@@ -45,6 +45,7 @@ CONFIG_VALIDATE_EXEMPT: dict[str, str] = {
     "result_dir": "free-form output path; None = no artifacts",
     "model_dir": "free-form checkpoint path; None = derived from result_dir",
     "profile_dir": "free-form XLA trace path; None = profiler off",
+    "history_dir": "free-form history-store path; None = result_dir/history",
     "is_gray": "boolean; both values valid",
     "ckpt_async": "boolean A/B switch; both values valid",
     "resume_force": "boolean escape hatch; both values valid",

@@ -114,6 +114,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry-port", type=int, default=None,
                    help="serve Prometheus /metrics + /healthz from the "
                    "storage process on this port (0/unset = off)")
+    p.add_argument("--history-dir", default=None,
+                   help="run-history time-series store location (unset = "
+                   "result_dir/history; the store exists only while the "
+                   "telemetry plane is on)")
+    p.add_argument("--history-chunk-s", type=float, default=None,
+                   help="history store chunk rotation period in seconds "
+                   "(default 60)")
+    p.add_argument("--history-retention-s", type=float, default=None,
+                   help="history store retention horizon in seconds — older "
+                   "chunks are GC'd at rotation (default 3600)")
     p.add_argument("--no-learn-diag", action="store_true",
                    help="disable the learning-dynamics plane (in-jit "
                    "entropy/KL/ESS/clip diagnostics, staleness-conditioned "
@@ -220,6 +230,12 @@ def load_config(args: argparse.Namespace) -> tuple[Config, MachinesConfig]:
         overrides["act_kernel"] = args.act_kernel
     if args.telemetry_port is not None:
         overrides["telemetry_port"] = args.telemetry_port
+    if args.history_dir is not None:
+        overrides["history_dir"] = args.history_dir
+    if args.history_chunk_s is not None:
+        overrides["history_chunk_s"] = args.history_chunk_s
+    if args.history_retention_s is not None:
+        overrides["history_retention_s"] = args.history_retention_s
     if args.no_learn_diag:
         overrides["learn_diag"] = False
     if args.watchdog_diag:

@@ -216,11 +216,20 @@ class AutopilotController:
         self._json_exp = None
         self._telem_pub = None
         self._emitter = None
+        self._history = None
         self._http_port = (
             http_port if http_port is not None
             else (cfg.telemetry_port + 1 if cfg.telemetry_port > 0 else 0)
         )
         self._setup_telemetry()
+        # Restart rehydration (run-history plane): a respawned controller
+        # inherits the dead one's signal windows — ALL kinds, so sustain
+        # streaks resume instead of restarting from empty.
+        self.n_rehydrated = 0
+        if self._history is not None:
+            from tpu_rl.autopilot.signals import rehydrate_signals
+
+            self.n_rehydrated = rehydrate_signals(self.store, self._history)
 
     # ------------------------------------------------------------- telemetry
     def _setup_telemetry(self) -> None:
@@ -233,6 +242,7 @@ class AutopilotController:
             PeriodicSnapshot,
             TelemetryAggregator,
             TelemetryHTTPServer,
+            maybe_history,
         )
         from tpu_rl.runtime.protocol import Protocol
         from tpu_rl.runtime.transport import make_data_pub
@@ -254,9 +264,17 @@ class AutopilotController:
             lambda snap: pub.send(Protocol.Telemetry, snap),
             interval_s=cfg.telemetry_interval_s,
         )
+        # Self-served history store (the controller is its own storage
+        # side): autopilot-* metrics plus every scraped signal window, fed
+        # on the exporter cadence, queryable live and rehydrated on restart.
+        self._history = maybe_history(cfg)
         if self._http_port > 0:
             self._http = TelemetryHTTPServer(
-                self.aggregator, self._http_port, autopilot=self.status_doc
+                self.aggregator, self._http_port, autopilot=self.status_doc,
+                query=(
+                    self._history.http_query
+                    if self._history is not None else None
+                ),
             )
         self._json_exp = JsonExporter(
             self.aggregator,
@@ -283,8 +301,15 @@ class AutopilotController:
         reg.counter("autopilot-scrape-errors").set_total(self.scraper.n_errors)
         if self._emitter is not None:
             self._emitter.maybe_emit()
-        if self._json_exp is not None:
-            self._json_exp.maybe_export()
+        if self._json_exp is not None and self._json_exp.maybe_export():
+            if self._history is not None:
+                from tpu_rl.autopilot.signals import signal_channels
+
+                # One history row per export: own metrics + the latest
+                # value of every scraped signal (the rehydration source).
+                self._history.record(
+                    self.aggregator, extra=signal_channels(self.store)
+                )
 
     # ----------------------------------------------------------------- audit
     def _event(self, ev: dict) -> None:
@@ -310,6 +335,7 @@ class AutopilotController:
             "counts": dict(self.counts),
             "rate_limited": self.engine.n_rate_limited,
             "clamped": self.engine.n_clamped,
+            "rehydrated": self.n_rehydrated,
             "signals": self.store.snapshot(),
         }
 
@@ -481,6 +507,14 @@ class AutopilotController:
             self._emitter.maybe_emit(now=float("inf"))
         if self._json_exp is not None:
             self._json_exp.maybe_export(now=float("inf"))
+        if self._history is not None:
+            from tpu_rl.autopilot.signals import signal_channels
+
+            # Final row + release the active chunk handle.
+            self._history.record(
+                self.aggregator, extra=signal_channels(self.store)
+            )
+            self._history.close()
         if self._http is not None:
             self._http.close()
         if self._telem_pub is not None:

@@ -173,6 +173,58 @@ class SignalScraper:
                 signals[f"counter:{key}"] = counters[key]
 
 
+# Channel-name prefix under which the controller persists every scraped
+# signal sample into its run-history store (one ``signals/<key>`` channel
+# per SignalStore key, all kinds: burn/goodput/straggler/gauge/counter).
+SIGNAL_CHANNEL_PREFIX = "signals/"
+
+
+def signal_channels(store: SignalStore) -> dict[str, float]:
+    """The store's latest values as history channels — what the controller
+    hands to ``TimeSeriesStore.record(extra=...)`` each exporter tick."""
+    return {
+        SIGNAL_CHANNEL_PREFIX + key: value
+        for key, value in store.snapshot().items()
+    }
+
+
+def rehydrate_signals(
+    store: SignalStore,
+    reader,
+    now_wall: float | None = None,
+    now_mono: float | None = None,
+) -> int:
+    """Refill a :class:`SignalStore`'s windows from a history store after
+    a controller restart, so sustain streaks resume where the dead
+    controller left off instead of restarting from empty — for EVERY
+    signal kind (goodput/straggler/gauge/counter/burn), not just the
+    ``/slo`` ``burn_history`` replay.
+
+    ``reader`` is a :class:`tpu_rl.obs.history.HistoryReader` (duck-typed:
+    ``series()`` + ``points()``). History timestamps are wall-clock; the
+    store's rings are monotonic — samples are converted through the
+    current wall-to-monotonic offset, and anything that would land in the
+    monotonic future (cross-boot history, clock steps) is dropped so the
+    ring's monotonic guard never rejects future LIVE samples. Returns the
+    number of samples restored."""
+    now_wall = time.time() if now_wall is None else now_wall
+    now_mono = store._clock() if now_mono is None else now_mono
+    offset = now_wall - now_mono  # t_wall = t_mono + offset
+    horizon = now_wall - store.window_s
+    n = 0
+    for ch in sorted(reader.series()):
+        if not ch.startswith(SIGNAL_CHANNEL_PREFIX):
+            continue
+        key = ch[len(SIGNAL_CHANNEL_PREFIX):]
+        for t_wall, value in reader.points(ch, start=horizon):
+            t_mono = t_wall - offset
+            if t_mono > now_mono:
+                continue
+            store.put(key, value, t=t_mono)
+            n += 1
+    return n
+
+
 def _family_kinds(body: str) -> dict:
     """``# TYPE`` lines -> {dash-name: kind} (histogram families skipped)."""
     kinds: dict[str, str] = {}

@@ -551,6 +551,20 @@ class Config:
     # shutdown has any hard-failing rule, the storage child exits nonzero
     # so smokes/CI fail loudly instead of averaging over a breached run.
     slo_fail_run: bool = False
+    # ---- run-history plane (tpu_rl.obs.history) ----
+    # Where the embedded time-series store lives. None = result_dir/history
+    # (the default wiring); set explicitly to split history from the other
+    # run artifacts. The store exists iff telemetry_enabled AND one of the
+    # two paths resolves — off costs one `is None` check per exporter tick.
+    history_dir: str | None = None
+    # Active-chunk rotation period: one chunk-<unix_ms>.jsonl file per this
+    # many seconds of samples. Smaller = finer-grained GC + smaller torn-
+    # crash exposure; larger = fewer files for long queries to open.
+    history_chunk_s: float = 60.0
+    # Retention horizon: on every rotation, chunks whose coverage ended
+    # more than this long ago are deleted. Disk is bounded by
+    # retention_s/chunk_s files regardless of run length.
+    history_retention_s: float = 3600.0
     # ---- population plane (tpu_rl.population) ----
     # PBT search-space + schedule grammar, e.g.
     # "lr:log[1e-4,1e-2] entropy_coef:lin[0,0.05] perturb=1.2,0.8
@@ -852,6 +866,12 @@ class Config:
         assert 0 <= self.telemetry_port < 65536, self.telemetry_port
         assert self.telemetry_interval_s > 0, self.telemetry_interval_s
         assert self.telemetry_stale_s > 0, self.telemetry_stale_s
+        assert self.history_chunk_s > 0, self.history_chunk_s
+        assert self.history_retention_s >= self.history_chunk_s, (
+            f"history_retention_s ({self.history_retention_s}) must cover at "
+            f"least one chunk ({self.history_chunk_s}s) — a shorter horizon "
+            "would GC every chunk at rotation time"
+        )
         assert self.trace_capacity >= 1, self.trace_capacity
         assert self.trace_sample_n >= 0, self.trace_sample_n
         assert self.action_repeat >= 1, self.action_repeat

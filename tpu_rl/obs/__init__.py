@@ -1,8 +1,11 @@
 """Fleet-wide observability: metrics registries, the telemetry aggregator,
 exporters (Prometheus / JSON / tensorboard), span tracing, the live
 performance plane (MFU/FLOPs/recompiles/device memory + profiler capture),
-the SLO engine, and the learning-dynamics plane (in-jit algorithm
-diagnostics with staleness-conditioned attribution — ``tpu_rl.obs.learn``).
+the SLO engine, the learning-dynamics plane (in-jit algorithm
+diagnostics with staleness-conditioned attribution — ``tpu_rl.obs.learn``),
+and the run-history plane (embedded time-series store + ``/query`` +
+anomaly detection — ``tpu_rl.obs.history``/``anomaly``, with the offline
+``tpu_rl.obs.report`` / ``tpu_rl.obs.compare`` CLIs reading it back).
 
 See ``docs/ARCHITECTURE.md`` ("Observability") for the data flow.
 """
@@ -13,6 +16,11 @@ from tpu_rl.obs.aggregator import (
     STALENESS_HIST,
     TelemetryAggregator,
     maybe_aggregator,
+)
+from tpu_rl.obs.anomaly import (
+    ANOMALY_LEVEL_SHIFTS_METRIC,
+    ANOMALY_SPIKES_METRIC,
+    AnomalyDetector,
 )
 from tpu_rl.obs.audit import append_jsonl, append_resume
 from tpu_rl.obs.clocksync import ClockEstimate, ClockSync
@@ -31,6 +39,15 @@ from tpu_rl.obs.goodput import (
     maybe_ledger,
     robust_z,
     straggler_report,
+)
+from tpu_rl.obs.history import (
+    HistoryReader,
+    TimeSeriesStore,
+    channel_name,
+    downsample,
+    flatten_snapshots,
+    history_path,
+    maybe_history,
 )
 from tpu_rl.obs.learn import (
     BUCKET_GAUGE_PREFIX,
@@ -68,6 +85,9 @@ from tpu_rl.obs.slo import SloEngine, SloRule, maybe_slo_engine, parse_slo_spec
 from tpu_rl.obs.trace import TraceRecorder
 
 __all__ = [
+    "ANOMALY_LEVEL_SHIFTS_METRIC",
+    "ANOMALY_SPIKES_METRIC",
+    "AnomalyDetector",
     "BUCKETS",
     "BUCKET_GAUGE_PREFIX",
     "ClockEstimate",
@@ -78,6 +98,7 @@ __all__ = [
     "GAUGE_PREFIX",
     "GoodputLedger",
     "HIST_BUCKETS",
+    "HistoryReader",
     "JsonExporter",
     "LEARNER_VERSION_GAUGE",
     "MetricsRegistry",
@@ -94,19 +115,25 @@ __all__ = [
     "TelemetryAggregator",
     "TelemetryHTTPServer",
     "TensorboardExporter",
+    "TimeSeriesStore",
     "TraceRecorder",
     "append_jsonl",
     "append_resume",
+    "channel_name",
     "derive",
     "device_memory_bytes",
     "device_peak_flops",
     "diff_snapshots",
+    "downsample",
     "ess_normalized",
     "explained_variance",
+    "flatten_snapshots",
     "hist_quantile",
+    "history_path",
     "host_stale_rows",
     "learn_record",
     "maybe_aggregator",
+    "maybe_history",
     "maybe_ledger",
     "maybe_perf_tracker",
     "maybe_slo_engine",

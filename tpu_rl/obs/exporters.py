@@ -25,6 +25,7 @@ import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl
 
 from tpu_rl.obs.aggregator import TelemetryAggregator
 from tpu_rl.obs.registry import HIST_BUCKETS, hist_quantile
@@ -146,7 +147,11 @@ class TelemetryHTTPServer:
     failure, so probes can alert off the status line alone), ``/goodput``
     (wall-clock attribution breakdown + straggler top-k), ``/autopilot``
     (the autopilot controller's live status: counts, recent actions with
-    reasons, per-rule cooldowns) and ``/prof?ms=N``
+    reasons, per-rule cooldowns), ``/query?metric=&start=&end=&step=``
+    (range queries over the run-history store when the owner wires a
+    ``query`` callable — raw points, or min/max/mean/last buckets when
+    ``step`` is set; without ``metric``, the series listing) and
+    ``/prof?ms=N``
     (bounded on-demand ``jax.profiler`` capture; an overlapping request is
     refused with 409). Daemonized: it must never hold the storage process
     open at shutdown, and :meth:`close` is idempotent and bounded so cluster
@@ -163,6 +168,7 @@ class TelemetryHTTPServer:
         prof=None,
         goodput=None,
         autopilot=None,
+        query=None,
     ):
         self.agg = agg
         self.tracez = tracez  # callable -> JSON-able dict, or None
@@ -170,6 +176,7 @@ class TelemetryHTTPServer:
         self.prof = prof  # callable (ms|None) -> (started, path|reason)
         self.goodput = goodput  # callable -> goodput/straggler doc, or None
         self.autopilot = autopilot  # callable -> autopilot status doc, or None
+        self.query = query  # callable (params dict) -> (status, doc), or None
 
         outer = self
 
@@ -211,6 +218,16 @@ class TelemetryHTTPServer:
                     else:
                         payload, status = outer.autopilot(), 200
                     body = (json.dumps(payload, indent=1) + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/query":
+                    if outer.query is None:
+                        payload = {"error": "history store not wired"}
+                        status = 404
+                    else:
+                        status, payload = outer.query(
+                            dict(parse_qsl(query))
+                        )
+                    body = (json.dumps(payload) + "\n").encode()
                     ctype = "application/json"
                 elif path == "/prof":
                     status, payload = outer._handle_prof(query)

@@ -8,7 +8,9 @@ breakdowns, throughput/MFU, the LEARN panel (entropy/KL/ESS update-math
 diagnostics with the ESS-vs-staleness curve from the
 ``learner-diag-by-stale-*`` families), the straggler list, autopilot
 replica/worker counts with recent actions and per-rule cooldown status,
-and SLO verdicts. Nothing beyond the standard library; point it at
+and SLO verdicts. When the run-history plane is on, each panel gains a
+unicode-block sparkline fed from ``GET /query`` (blank when the plane
+is off). Nothing beyond the standard library; point it at
 any fleet with the plane on::
 
     python -m tpu_rl.obs.top --url http://learner-host:9090/metrics
@@ -27,6 +29,7 @@ import json
 import re
 import urllib.error
 import urllib.request
+from urllib.parse import quote
 
 from tpu_rl.obs.goodput import BUCKETS
 
@@ -150,7 +153,93 @@ def bar(frac: float, width: int = 20) -> str:
     return "#" * filled + "-" * (width - filled)
 
 
+# --------------------------------------------------------------- sparklines
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+# History-channel tails worth a sparkline (suffix match against the
+# ``/query`` series listing; labeled per-wid channels are skipped — the
+# panel shows role-level trends, the straggler list covers outliers).
+SPARK_SUFFIXES = (
+    "-env-steps-per-s",
+    "-throughput",
+    "-updates-per-s",
+    "-mfu",
+    "-goodput-ratio",
+    "-mean-episode-return",
+    "-diag-ess",
+)
+_SPARK_FETCH_CAP = 12  # bound the per-frame /query fan-out
+_SPARK_WIDTH = 24
+
+
+def sparkline(values: list, width: int = _SPARK_WIDTH) -> str:
+    """Values -> a fixed-width unicode-block trend line (empty string on
+    no data). Longer series are bucket-mean compressed to ``width``; a
+    flat series renders mid-height, not empty."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        per = len(vals) / width
+        vals = [
+            sum(chunk) / len(chunk)
+            for chunk in (
+                vals[int(i * per): max(int(i * per) + 1, int((i + 1) * per))]
+                for i in range(width)
+            )
+        ]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK_BLOCKS[3] * len(vals)
+    scale = (len(SPARK_BLOCKS) - 1) / (hi - lo)
+    return "".join(SPARK_BLOCKS[int((v - lo) * scale)] for v in vals)
+
+
+def collect_history(
+    base: str, timeout: float = 2.0, fetch_json_fn=fetch_json
+) -> dict | None:
+    """Poll the run-history plane once: the ``/query`` series listing,
+    then raw points for every spark-worthy channel -> ``{channel tail:
+    [values]}``. Returns None when the plane is off (the endpoint 404s
+    with an error body, or the server predates it) — every panel then
+    renders without its trend line, never an error."""
+    listing = fetch_json_fn(base + "/query", timeout)
+    if not isinstance(listing, dict) or "series" not in listing:
+        return None
+    out: dict = {}
+    for row in listing.get("series", ()):
+        name = (row or {}).get("name")
+        if not isinstance(name, str) or "{" in name:
+            continue
+        tail = name.rpartition("/")[2]
+        if tail in out or not tail.endswith(SPARK_SUFFIXES):
+            continue
+        if len(out) >= _SPARK_FETCH_CAP:
+            break
+        doc = fetch_json_fn(
+            base + "/query?metric=" + quote(name, safe=""), timeout
+        )
+        points = (doc or {}).get("points") if isinstance(doc, dict) else None
+        if points:
+            out[tail] = [p[1] for p in points if isinstance(p, list)]
+    return out
+
+
 # ------------------------------------------------------------------ frame
+_HOT_METRICS = (
+    ("learner tps", "learner_throughput", "{:,.0f}"),
+    ("colocated tps", "colocated_env_steps_per_s", "{:,.0f}"),
+    ("mfu", "learner_mfu", "{:.2%}"),
+    ("colocated mfu", "colocated_mfu", "{:.2%}"),
+    ("recompiles", "learner_xla_recompiles", "{:.0f}"),
+)
+
+
+def _spark(history: dict | None, tail: str) -> str:
+    vals = (history or {}).get(tail)
+    return sparkline(vals) if vals else ""
+
+
 def build_frame(
     samples: list,
     goodput_doc: dict | None,
@@ -158,8 +247,11 @@ def build_frame(
     url: str = DEFAULT_URL,
     width: int = 100,
     autopilot_doc: dict | None = None,
+    history: dict | None = None,
 ) -> list:
-    """The whole dashboard as a list of text lines (pure; golden-tested)."""
+    """The whole dashboard as a list of text lines (pure; golden-tested).
+    ``history`` is the :func:`collect_history` channel-tail dict; None
+    (plane off) renders every panel without its trend line."""
     lines = [f"tpu_rl top — {url}  (q quits)", ""]
     rows = goodput_rows(samples)
     lines.append("GOODPUT (compute share of wall time, per role)")
@@ -168,7 +260,9 @@ def build_frame(
     for key in sorted(rows):
         row = rows[key]
         g = row["goodput"]
-        lines.append(f"  {key:<16} [{bar(g)}] {g * 100:5.1f}%")
+        spark = _spark(history, f"{key}-goodput-ratio")
+        tail = f"  {spark}" if spark else ""
+        lines.append(f"  {key:<16} [{bar(g)}] {g * 100:5.1f}%{tail}")
         top = sorted(
             row["buckets"].items(), key=lambda kv: -kv[1]
         )[:4]
@@ -178,18 +272,16 @@ def build_frame(
     lines.append("")
 
     hot = []
-    for label, metric, fmt in (
-        ("learner tps", "learner_throughput", "{:,.0f}"),
-        ("colocated tps", "colocated_env_steps_per_s", "{:,.0f}"),
-        ("mfu", "learner_mfu", "{:.2%}"),
-        ("colocated mfu", "colocated_mfu", "{:.2%}"),
-        ("recompiles", "learner_xla_recompiles", "{:.0f}"),
-    ):
+    for label, metric, fmt in _HOT_METRICS:
         v = _scalar(samples, metric)
         if v is not None:
             hot.append(f"{label} {fmt.format(v)}")
     if hot:
         lines.append("THROUGHPUT  " + "   ".join(hot))
+        for label, metric, _fmt in _HOT_METRICS:
+            spark = _spark(history, metric.replace("_", "-"))
+            if spark:
+                lines.append(f"  {label:<14} {spark}")
         lines.append("")
 
     diag, diag_buckets = learn_rows(samples)
@@ -289,9 +381,10 @@ def build_frame(
 
 
 def collect(url: str, timeout: float = 2.0):
-    """Fetch all four endpoints once → (samples, goodput, slo, autopilot,
-    ok). ``/autopilot`` is None on fleets without the pilot wired (the
-    endpoint 404s with a JSON error body — filtered here)."""
+    """Fetch all five endpoints once → (samples, goodput, slo, autopilot,
+    history, ok). ``/autopilot`` is None on fleets without the pilot
+    wired (the endpoint 404s with a JSON error body — filtered here);
+    ``history`` is None on fleets without the run-history plane."""
     base = url.rsplit("/", 1)[0] if url.endswith("/metrics") else url
     status, body = fetch(url, timeout)
     ok = status == 200
@@ -301,7 +394,8 @@ def collect(url: str, timeout: float = 2.0):
     autopilot_doc = fetch_json(base + "/autopilot", timeout)
     if isinstance(autopilot_doc, dict) and "error" in autopilot_doc:
         autopilot_doc = None
-    return samples, goodput_doc, slo_doc, autopilot_doc, ok
+    history = collect_history(base, timeout)
+    return samples, goodput_doc, slo_doc, autopilot_doc, history, ok
 
 
 # ----------------------------------------------------------------- curses
@@ -327,11 +421,12 @@ def _loop(stdscr, args) -> int:
         pass
     stdscr.timeout(int(args.interval * 1000))
     while True:
-        samples, goodput_doc, slo_doc, ap_doc, ok = collect(
+        samples, goodput_doc, slo_doc, ap_doc, history, ok = collect(
             args.url, args.timeout
         )
         lines = build_frame(
-            samples, goodput_doc, slo_doc, url=args.url, autopilot_doc=ap_doc
+            samples, goodput_doc, slo_doc, url=args.url,
+            autopilot_doc=ap_doc, history=history,
         )
         if not ok:
             lines.insert(1, f"  !! /metrics unreachable at {args.url}")
@@ -356,11 +451,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.once:
-        samples, goodput_doc, slo_doc, ap_doc, ok = collect(
+        samples, goodput_doc, slo_doc, ap_doc, history, ok = collect(
             args.url, args.timeout
         )
         frame = build_frame(
-            samples, goodput_doc, slo_doc, url=args.url, autopilot_doc=ap_doc
+            samples, goodput_doc, slo_doc, url=args.url,
+            autopilot_doc=ap_doc, history=history,
         )
         print("\n".join(frame))
         return 0 if ok else 1

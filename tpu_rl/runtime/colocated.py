@@ -168,6 +168,10 @@ class ColocatedLoop:
         self._perf = None
         self._prof = None
         self._slo = None
+        # Run-history store (tpu_rl.obs.history): the colocated deployment
+        # is its own storage side, so it self-serves the plane — fed on the
+        # exporter cadence, served live at /query. None = plane off.
+        self._history = None
         # Goodput ledger for the fused loop (tpu_rl.obs.goodput). The whole
         # deployment is one process, so one ledger covers it: dispatch +
         # blocking device_get land in compute, checkpoint saves in ckpt,
@@ -336,6 +340,7 @@ class ColocatedLoop:
             ProfilerCapture,
             TelemetryAggregator,
             TelemetryHTTPServer,
+            maybe_history,
             maybe_slo_engine,
         )
 
@@ -346,6 +351,7 @@ class ColocatedLoop:
         self.ledger = GoodputLedger("colocated")
         self._perf = PerfTracker()
         self._slo = maybe_slo_engine(cfg)
+        self._history = maybe_history(cfg)
         if cfg.result_dir is not None:
             self._prof = ProfilerCapture(os.path.join(cfg.result_dir, "prof"))
         if cfg.telemetry_port > 0:
@@ -357,6 +363,10 @@ class ColocatedLoop:
                     self._prof.capture_async if self._prof is not None else None
                 ),
                 goodput=self._goodput_payload,
+                query=(
+                    self._history.http_query
+                    if self._history is not None else None
+                ),
             )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
@@ -414,6 +424,10 @@ class ColocatedLoop:
         if self._slo is not None:
             self._slo.evaluate(self.aggregator)
         if self._json_exp is not None and self._json_exp.maybe_export():
+            if self._history is not None:
+                # Same cadence decision the JSON exporter just made: one
+                # flattened history row per export.
+                self._history.record(self.aggregator)
             if self.ledger is not None:
                 # Ledger audit trail on the exporter's cadence — the offline
                 # twin of GET /goodput, same file name as storage writes.
@@ -467,6 +481,11 @@ class ColocatedLoop:
         if self._json_exp is not None:
             # Force a final write regardless of the exporter's cadence.
             self._json_exp.maybe_export(now=float("inf"))
+        if self._history is not None:
+            # Final history row + release the active chunk handle.
+            self._history.record(self.aggregator)
+            self._history.close()
+            self._history = None
 
     @property
     def slo_failed(self) -> bool:

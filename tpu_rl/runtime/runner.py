@@ -116,6 +116,11 @@ class Supervisor:
     # Optional tpu_rl.chaos.ProcessChaos, polled from loop() — the
     # supervisor is the only place that knows every child's name and pid.
     chaos: Any = None
+    # Audit sink for chaos injections (result_dir/chaos.jsonl, the same
+    # unified jsonl discipline as rollback/resume/population/autopilot
+    # events) so post-hoc run reports can overlay process faults on the
+    # recorded curves. None = no audit (best-effort either way).
+    audit_dir: str | None = None
 
     def __post_init__(self):
         self.stop_event = self.ctx.Event()
@@ -140,6 +145,7 @@ class Supervisor:
             backoff_max_s=cfg.restart_backoff_max_s,
             poll_s=cfg.supervise_poll_s,
             chaos=chaos,
+            audit_dir=getattr(cfg, "result_dir", None),
             **kw,
         )
 
@@ -279,6 +285,14 @@ class Supervisor:
             if self.chaos is not None:
                 for action, name in self.chaos.poll(self.children):
                     print(f"[chaos] {action} -> {name}")
+                    from tpu_rl.obs.audit import append_jsonl
+
+                    # Same record shape the autopilot's chaos poll audits,
+                    # so report overlays read one schema.
+                    append_jsonl(
+                        self.audit_dir, "chaos.jsonl",
+                        {"ev": "chaos", "action": action, "target": name},
+                    )
             restarted = self.check()
             for name in restarted:
                 print(f"[supervisor] restarted silent/dead child: {name}")

@@ -248,13 +248,16 @@ class SebulbaLoop(ColocatedLoop):
         }
 
     def _telemetry_tick(self, *args) -> None:
-        super()._telemetry_tick(*args)
+        # Queue gauges BEFORE the base tick: the base tick may export (and
+        # record a history row), and that row should carry this tick's
+        # depth, not the previous one's.
         if self.aggregator is not None and self._pipe is not None:
             reg = self.aggregator.registry
             reg.gauge("sebulba-queue-depth").set(float(self._pipe.qsize()))
             reg.gauge("sebulba-queue-peak-depth").set(
                 float(self._pipe.peak_depth)
             )
+        super()._telemetry_tick(*args)
 
     # ---------------------------------------------------------------- run loop
     def _actor_loop(self, carry, stats, needed: int | None) -> None:

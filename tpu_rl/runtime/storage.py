@@ -172,6 +172,10 @@ class LearnerStorage:
         self._http = None
         self._json_exp = None
         self._tb_exp = None
+        # Run-history plane (tpu_rl.obs.history): the embedded time-series
+        # store fed on the JSON exporter's cadence; /query serves it live.
+        # None when the plane is off — one `is None` check per export tick.
+        self._history = None
         # Goodput plane (tpu_rl.obs.goodput): this loop's own wall-clock
         # ledger plus the per-wid straggler signals the fleet report is
         # built from. `_wid_frames` doubles as the plane gate on the ingest
@@ -348,6 +352,7 @@ class LearnerStorage:
             TelemetryAggregator,
             TelemetryHTTPServer,
             TensorboardExporter,
+            maybe_history,
             maybe_slo_engine,
         )
         from tpu_rl.utils.metrics import NullWriter, make_writer
@@ -359,6 +364,7 @@ class LearnerStorage:
         self.ledger = GoodputLedger("storage")
         self._wid_frames = {}
         self._slo = maybe_slo_engine(cfg)
+        self._history = maybe_history(cfg)
         if cfg.result_dir is not None:
             self._prof = ProfilerCapture(os.path.join(cfg.result_dir, "prof"))
         if cfg.telemetry_port > 0:
@@ -371,6 +377,10 @@ class LearnerStorage:
                     self._prof.capture_async if self._prof is not None else None
                 ),
                 goodput=self._goodput_payload,
+                query=(
+                    self._history.http_query
+                    if self._history is not None else None
+                ),
             )
         if cfg.result_dir is not None:
             self._json_exp = JsonExporter(
@@ -470,6 +480,11 @@ class LearnerStorage:
             if self._slo is not None:
                 self._slo.evaluate(self.aggregator)
         if self._json_exp is not None and self._json_exp.maybe_export():
+            if self._history is not None:
+                # History rides the SAME cadence decision the JSON exporter
+                # just made: one flattened row of every role's snapshot per
+                # export, no clock of its own.
+                self._history.record(self.aggregator)
             if self.ledger is not None:
                 # Ledger + straggler audit trail on the exporter's cadence:
                 # one JSON line per export, the offline twin of GET /goodput.
@@ -574,6 +589,11 @@ class LearnerStorage:
                     json.dump(self._slo.report(), f, indent=2)
         if self._json_exp is not None:
             self._json_exp.maybe_export(now=float("inf"))  # final snapshot
+        if self._history is not None:
+            # One last row so the stored run ends at the final state, then
+            # release the active chunk handle.
+            self._history.record(self.aggregator)
+            self._history.close()
         if self._tb_exp is not None:
             self._tb_exp.export(self.aggregator)
             self._tb_exp.close()
